@@ -1,0 +1,1 @@
+"""Native (C++) hot paths, loaded via ctypes. Python fallbacks when unbuilt."""

@@ -1,0 +1,84 @@
+"""ctypes bindings for the C++ GF(2^8) RS kernel (CPU baseline).
+
+The shared library is built by `make -C seaweedfs_tpu/native` (see
+Makefile); when absent, callers fall back to the numpy path in
+seaweedfs_tpu/ops/gf256.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "librs_cpu.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None and os.path.exists(_LIB_PATH):
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.gf_linear.restype = None
+        lib.gf_linear.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),  # matrix [out, k]
+            ctypes.c_int,                    # out rows
+            ctypes.c_int,                    # k cols
+            ctypes.POINTER(ctypes.c_uint8),  # shards [k, n] (contiguous)
+            ctypes.POINTER(ctypes.c_uint8),  # out [out, n]
+            ctypes.c_longlong,               # n
+        ]
+        lib.crc32_ieee.restype = ctypes.c_uint32
+        lib.crc32_ieee.argtypes = [
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_longlong,
+        ]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def apply_matrix(matrix: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """matrix [O, K] uint8 x shards [..., K, N] uint8 -> [..., O, N]."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("librs_cpu.so not built")
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    o, k = matrix.shape
+    if shards.shape[-2] != k:
+        raise ValueError(f"shard count {shards.shape[-2]} != matrix cols {k}")
+    n = shards.shape[-1]
+    batch_shape = shards.shape[:-2]
+    flat = shards.reshape((-1, k, n))
+    out = np.empty((flat.shape[0], o, n), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    mp = matrix.ctypes.data_as(u8p)
+    for b in range(flat.shape[0]):
+        lib.gf_linear(
+            mp, o, k,
+            flat[b].ctypes.data_as(u8p),
+            out[b].ctypes.data_as(u8p),
+            ctypes.c_longlong(n),
+        )
+    return out.reshape(batch_shape + (o, n))
+
+
+def crc32(data, value: int = 0) -> int:
+    """IEEE CRC32 (zlib-compatible) of a bytes-like; native if built."""
+    lib = _load()
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    if lib is None:
+        import zlib
+        return zlib.crc32(buf, value) & 0xFFFFFFFF
+    if buf.size == 0:
+        return value
+    return int(lib.crc32_ieee(
+        ctypes.c_uint32(value),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_longlong(buf.size)))

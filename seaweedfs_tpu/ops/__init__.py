@@ -1,0 +1,6 @@
+"""TPU compute kernels: GF(2^8) arithmetic and Reed-Solomon codecs."""
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS
+
+__all__ = ["gf256", "ReedSolomon", "DATA_SHARDS", "PARITY_SHARDS", "TOTAL_SHARDS"]

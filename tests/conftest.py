@@ -1,0 +1,15 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is unavailable in the dev loop; sharding logic is
+validated on 8 virtual CPU devices (the driver's dryrun_multichip does the
+same). Must run before jax is imported anywhere.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env may point at real TPU
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,98 @@
+"""GF(2^8) field + matrix algebra unit tests."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def test_field_basics():
+    assert gf256.gf_mul(0, 5) == 0
+    assert gf256.gf_mul(1, 5) == 5
+    assert gf256.gf_mul(5, 1) == 5
+    # known products in poly 0x11D: 2*0x80 = 0x100 reduced by 0x11D -> 0x1D
+    assert gf256.gf_mul(2, 0x80) == 0x1D
+    assert gf256.gf_mul(4, 0x80) == 0x3A
+
+
+def test_mul_commutative_associative_distributive():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(gf256.gf_mul(a, b), c)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+
+
+def test_div_inverse():
+    for a in range(1, 256):
+        inv = gf256.gf_inv(a)
+        assert gf256.gf_mul(a, inv) == 1
+        assert gf256.gf_div(gf256.gf_mul(7, a), a) == 7
+
+
+def test_mul_table_matches_scalar():
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, 256, (100, 2))
+    for a, b in idx:
+        assert gf256.GF_MUL_TABLE[a, b] == gf256.gf_mul(int(a), int(b))
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 3, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.mat_inv(m)
+                break
+            except ValueError:
+                continue
+        prod = gf256.mat_mul(m, inv)
+        assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+
+
+def test_mat_inv_singular_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.mat_inv(m)
+
+
+def test_rs_matrix_systematic_and_mds():
+    m = gf256.rs_coding_matrix(10, 14)
+    assert m.shape == (14, 10)
+    assert np.array_equal(m[:10], np.eye(10, dtype=np.uint8))
+    # MDS property: any 10 rows are invertible
+    rng = np.random.default_rng(3)
+    for _ in range(20):
+        rows = sorted(rng.choice(14, 10, replace=False))
+        gf256.mat_inv(m[rows])  # must not raise
+
+
+def test_bit_matrix_expansion_matches_field_mul():
+    rng = np.random.default_rng(4)
+    for _ in range(50):
+        c, x = (int(v) for v in rng.integers(0, 256, 2))
+        b = gf256.byte_to_bits_matrix(c)
+        xbits = np.array([(x >> j) & 1 for j in range(8)], dtype=np.uint8)
+        ybits = (b @ xbits) % 2
+        y = int(sum(int(ybits[k]) << k for k in range(8)))
+        assert y == gf256.gf_mul(c, x)
+
+
+def test_gf_linear_numpy_matches_matmul():
+    rng = np.random.default_rng(5)
+    m = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    data = rng.integers(0, 256, (10, 64)).astype(np.uint8)
+    out = gf256.gf_linear_numpy(m, data)
+    ref = gf256.mat_mul(m, data)
+    assert np.array_equal(out, ref)
+
+
+def test_gf_linear_numpy_batched():
+    rng = np.random.default_rng(6)
+    m = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    data = rng.integers(0, 256, (3, 10, 32)).astype(np.uint8)
+    out = gf256.gf_linear_numpy(m, data)
+    for b in range(3):
+        assert np.array_equal(out[b], gf256.mat_mul(m, data[b]))
