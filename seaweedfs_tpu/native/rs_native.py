@@ -35,6 +35,8 @@ def _load():
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_longlong,
         ]
+        lib.crc32c.restype = ctypes.c_uint32
+        lib.crc32c.argtypes = lib.crc32_ieee.argtypes
         _lib = lib
     return _lib
 
@@ -79,6 +81,40 @@ def crc32(data, value: int = 0) -> int:
     if buf.size == 0:
         return value
     return int(lib.crc32_ieee(
+        ctypes.c_uint32(value),
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_longlong(buf.size)))
+
+
+_CRC32C_TABLE = None
+
+
+def _crc32c_py(buf: np.ndarray, value: int) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        tab = np.zeros(256, dtype=np.uint32)
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if (c & 1) else (c >> 1)
+            tab[i] = c
+        _CRC32C_TABLE = tab
+    crc = (~value) & 0xFFFFFFFF
+    tab = _CRC32C_TABLE
+    for b in buf.tobytes():
+        crc = int(tab[(crc ^ b) & 0xFF]) ^ (crc >> 8)
+    return (~crc) & 0xFFFFFFFF
+
+
+def crc32c(data, value: int = 0) -> int:
+    """Castagnoli CRC32 — the needle checksum flavor; native if built."""
+    lib = _load()
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    if buf.size == 0:
+        return value
+    if lib is None:
+        return _crc32c_py(buf, value)
+    return int(lib.crc32c(
         ctypes.c_uint32(value),
         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.c_longlong(buf.size)))

@@ -1,0 +1,17 @@
+"""Volume-server storage engine: on-disk formats and the volume store.
+
+Format-compatible with the reference (/root/reference weed/storage):
+needle blobs in append-only .dat files, 16-byte .idx entries, 8-byte
+superblock — all big-endian, needles padded to 8 bytes, CRC32-Castagnoli
+checksums with the snappy-style mask.
+"""
+
+from seaweedfs_tpu.storage.types import (
+    NEEDLE_PADDING, NEEDLE_HEADER_SIZE, NEEDLE_MAP_ENTRY_SIZE,
+    TOMBSTONE_SIZE, FileId, size_is_deleted, size_is_valid,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.superblock import SuperBlock, ReplicaPlacement, TTL
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.disk_location import DiskLocation

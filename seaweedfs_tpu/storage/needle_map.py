@@ -1,0 +1,174 @@
+"""In-memory needle id -> (offset, size) indexes.
+
+The reference offers several NeedleMapper kinds (compact in-memory map,
+leveldb, sorted file — weed/storage/needle_map*.go). Here the in-memory
+kind is a Python dict with numpy-vectorized .idx loading (idiomatic
+replacement for the Go CompactMap, which exists to dodge GC overhead the
+CPython runtime doesn't have in the same way), plus the same metrics the
+reference tracks (file/deleted counts and sizes, max key).
+
+SortedIndex provides binary search over a key-sorted index blob — the
+.ecx access pattern (reference weed/storage/erasure_coding/ec_volume.go).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage import types as t
+
+
+@dataclass
+class NeedleValue:
+    offset: int  # actual byte offset in .dat
+    size: int    # body size; TOMBSTONE/negative = deleted
+
+
+class NeedleMap:
+    """Dict-backed needle map bound to an append-only .idx file."""
+
+    def __init__(self, index_path: Optional[str] = None):
+        self._map: dict[int, Tuple[int, int]] = {}
+        self._lock = threading.Lock()
+        self.index_path = index_path
+        self._index_file = None
+        self.file_count = 0
+        self.deleted_count = 0
+        self.content_size = 0      # sum of actual disk sizes put
+        self.deleted_size = 0      # sum of sizes deleted
+        self.max_key = 0
+        if index_path is not None:
+            self._load(index_path)
+            self._index_file = open(index_path, "ab")
+
+    # -- loading -------------------------------------------------------------
+
+    def _load(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            buf = f.read()
+        # a torn trailing partial entry (crash mid-append) must be cut off
+        # BEFORE we reopen for append, or every later entry lands misaligned
+        usable = len(buf) - (len(buf) % t.NEEDLE_MAP_ENTRY_SIZE)
+        if usable != len(buf):
+            with open(path, "r+b") as f:
+                f.truncate(usable)
+            buf = buf[:usable]
+        arr = idx_codec.parse_index_bytes(buf)
+        if not len(arr):
+            return
+        keys = arr["key"]
+        sizes = arr["size"].astype(np.int64)
+        offsets = arr["offset"]
+        # vectorized replay: totals from all puts, final state from the
+        # last entry per key; "deleted" = puts that aren't final live state
+        puts = sizes >= 0
+        self.file_count = int(puts.sum())
+        self.content_size = int(sizes[puts].sum())
+        self.max_key = int(keys.max())
+        # index of the last occurrence of each key
+        _, first_of_reversed = np.unique(keys[::-1], return_index=True)
+        last_idx = len(keys) - 1 - first_of_reversed
+        live = last_idx[sizes[last_idx] >= 0]
+        for i in live:
+            self._map[int(keys[i])] = (int(offsets[i]), int(sizes[i]))
+        self.deleted_count = self.file_count - len(live)
+        self.deleted_size = self.content_size - int(sizes[live].sum())
+
+    # -- NeedleMapper API ----------------------------------------------------
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        with self._lock:
+            prev = self._map.get(key)
+            if prev is not None and not t.size_is_deleted(prev[1]):
+                self.deleted_count += 1
+                self.deleted_size += prev[1]
+            self._map[key] = (offset, size)
+            self.file_count += 1
+            self.content_size += size
+            self.max_key = max(self.max_key, key)
+            self._append_entry(key, offset, size)
+
+    def get(self, key: int) -> Optional[NeedleValue]:
+        v = self._map.get(key)
+        if v is None or t.size_is_deleted(v[1]):
+            return None
+        return NeedleValue(offset=v[0], size=v[1])
+
+    def delete(self, key: int, marker_offset: int) -> int:
+        """Record a tombstone; returns the freed size (0 if absent)."""
+        with self._lock:
+            prev = self._map.pop(key, None)
+            if prev is None or t.size_is_deleted(prev[1]):
+                return 0
+            self.deleted_count += 1
+            self.deleted_size += prev[1]
+            self._append_entry(key, marker_offset, t.TOMBSTONE_SIZE)
+            return prev[1]
+
+    def _append_entry(self, key: int, offset: int, size: int) -> None:
+        if self._index_file is not None:
+            self._index_file.write(idx_codec.entry_to_bytes(key, offset, size))
+            self._index_file.flush()
+
+    def sync(self) -> None:
+        if self._index_file is not None:
+            self._index_file.flush()
+            os.fsync(self._index_file.fileno())
+
+    def close(self) -> None:
+        if self._index_file is not None:
+            self._index_file.close()
+            self._index_file = None
+
+    def destroy(self) -> None:
+        self.close()
+        if self.index_path and os.path.exists(self.index_path):
+            os.remove(self.index_path)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def keys(self):
+        return self._map.keys()
+
+    def items(self):
+        for k, (off, size) in self._map.items():
+            yield k, NeedleValue(offset=off, size=size)
+
+
+class SortedIndex:
+    """Binary search over a key-sorted 16-byte-entry index (.ecx pattern).
+
+    Backed by a numpy view; lookup is O(log n) via searchsorted.
+    """
+
+    def __init__(self, buf: bytes):
+        arr = idx_codec.parse_index_bytes(buf)
+        self.keys = arr["key"]
+        self.offsets = arr["offset"]
+        self.sizes = arr["size"]
+        if len(self.keys) > 1 and not np.all(self.keys[:-1] <= self.keys[1:]):
+            raise ValueError("index not sorted by key")
+
+    @classmethod
+    def from_file(cls, path: str) -> "SortedIndex":
+        with open(path, "rb") as f:
+            return cls(f.read())
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def find(self, key: int) -> Optional[Tuple[int, int, int]]:
+        """Return (entry_index, offset, size) or None."""
+        i = int(np.searchsorted(self.keys, np.uint64(key)))
+        if i < len(self.keys) and self.keys[i] == key:
+            return i, int(self.offsets[i]), int(self.sizes[i])
+        return None

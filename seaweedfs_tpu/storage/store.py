@@ -1,0 +1,170 @@
+"""Store: all DiskLocations of one volume server; routes ops by volume id.
+
+Reference: weed/storage/store.go (struct :32-48, read/write/delete
+:302-330, CollectHeartbeat :203).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.needle import Needle, NeedleError
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement, TTL
+from seaweedfs_tpu.storage.volume import Volume
+
+
+class Store:
+    def __init__(self, directories: List[str], max_volume_counts: Optional[List[int]] = None,
+                 ip: str = "", port: int = 0, public_url: str = ""):
+        if max_volume_counts is None:
+            max_volume_counts = [8] * len(directories)
+        self.locations = [DiskLocation(d, c)
+                          for d, c in zip(directories, max_volume_counts)]
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or (f"{ip}:{port}" if ip else "")
+        self._lock = threading.RLock()
+        # deltas queued for the next heartbeat
+        self.new_volumes: List[dict] = []
+        self.deleted_volumes: List[dict] = []
+        for loc in self.locations:
+            loc.load_existing_volumes()
+
+    # -- volume routing ------------------------------------------------------
+
+    def find_volume(self, vid: int) -> Optional[Volume]:
+        for loc in self.locations:
+            v = loc.get_volume(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int):
+        for loc in self.locations:
+            ecv = loc.ec_volumes.get(vid)
+            if ecv is not None:
+                return ecv
+        return None
+
+    def location_of(self, vid: int) -> Optional[DiskLocation]:
+        for loc in self.locations:
+            if loc.get_volume(vid) is not None or vid in loc.ec_volumes:
+                return loc
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replica_placement: str = "000", ttl: str = "") -> Volume:
+        with self._lock:
+            existing = self.find_volume(vid)
+            if existing is not None:
+                return existing
+            for loc in self.locations:
+                if loc.has_free_slot():
+                    v = loc.add_volume(
+                        vid, collection,
+                        replica_placement=ReplicaPlacement.parse(replica_placement),
+                        ttl=TTL.parse(ttl))
+                    self.new_volumes.append(self.volume_info(v))
+                    return v
+            raise RuntimeError("no free volume slot on any disk location")
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.get_volume(vid)
+                if v is not None:
+                    info = self.volume_info(v)
+                    loc.delete_volume(vid)
+                    self.deleted_volumes.append(info)
+                    return True
+        return False
+
+    def mark_volume_readonly(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = True
+        return True
+
+    def mark_volume_writable(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = False
+        return True
+
+    # -- data ops ------------------------------------------------------------
+
+    def write_needle(self, vid: int, n: Needle, fsync: bool = False):
+        v = self.find_volume(vid)
+        if v is None:
+            raise NeedleError(f"volume {vid} not found")
+        return v.write_needle(n, fsync=fsync)
+
+    def read_needle(self, vid: int, n: Needle) -> Needle:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NeedleError(f"volume {vid} not found")
+        return v.read_needle(n)
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NeedleError(f"volume {vid} not found")
+        return v.delete_needle(n)
+
+    # -- heartbeat -----------------------------------------------------------
+
+    @staticmethod
+    def volume_info(v: Volume) -> dict:
+        return {
+            "id": v.id,
+            "collection": v.collection,
+            "size": v.content_size,
+            "file_count": v.file_count,
+            "delete_count": v.deleted_count,
+            "deleted_byte_count": v.deleted_size,
+            "read_only": v.read_only,
+            "replica_placement": v.replica_placement.to_byte(),
+            "ttl": str(v.ttl),
+            "version": v.version,
+        }
+
+    def collect_heartbeat(self) -> dict:
+        with self._lock:
+            volumes = []
+            ec_shards = []
+            for loc in self.locations:
+                for v in loc.volumes.values():
+                    volumes.append(self.volume_info(v))
+                for vid, ecv in loc.ec_volumes.items():
+                    ec_shards.append({
+                        "id": vid,
+                        "collection": ecv.collection,
+                        "ec_index_bits": ecv.shard_bits,
+                    })
+            hb = {
+                "ip": self.ip,
+                "port": self.port,
+                "public_url": self.public_url,
+                "max_volume_count": sum(l.max_volume_count for l in self.locations),
+                "volumes": volumes,
+                "ec_shards": ec_shards,
+                "new_volumes": self.new_volumes[:],
+                "deleted_volumes": self.deleted_volumes[:],
+                "max_file_key": max(
+                    (v.nm.max_key for loc in self.locations
+                     for v in loc.volumes.values()), default=0),
+            }
+            self.new_volumes.clear()
+            self.deleted_volumes.clear()
+            return hb
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()

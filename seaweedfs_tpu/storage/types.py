@@ -1,0 +1,92 @@
+"""Core on-disk scalar types and the file-id grammar.
+
+Mirrors the reference's weed/storage/types (needle_types.go:34-39,
+offset_4bytes.go) and weed/storage/needle/file_id.go behavior:
+  - NeedleId: 8 bytes big-endian
+  - Offset: 4 bytes big-endian, in units of 8 (NEEDLE_PADDING) -> 32GB max
+  - Size: 4 bytes big-endian, int32 semantics; -1 (0xFFFFFFFF) = tombstone
+  - fid string: "<volumeId>,<key hex><cookie 8-hex>"
+"""
+
+from __future__ import annotations
+
+import secrets
+import struct
+from dataclasses import dataclass
+
+NEEDLE_ID_SIZE = 8
+OFFSET_SIZE = 4
+SIZE_SIZE = 4
+COOKIE_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING = 8
+NEEDLE_CHECKSUM_SIZE = 4
+TOMBSTONE_SIZE = -1  # Size(-1) marks a deleted needle in the index
+MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB (4B offset * 8)
+
+
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_SIZE
+
+
+def size_to_int32(size: int) -> int:
+    """Reinterpret a uint32 read from disk as int32 Size semantics."""
+    return size - (1 << 32) if size >= (1 << 31) else size
+
+
+def offset_to_bytes(actual_offset: int) -> bytes:
+    """Store actual byte offset / 8 as 4 bytes big-endian."""
+    if actual_offset % NEEDLE_PADDING != 0:
+        raise ValueError(f"offset {actual_offset} not 8-byte aligned")
+    return struct.pack(">I", actual_offset // NEEDLE_PADDING)
+
+
+def bytes_to_offset(b: bytes) -> int:
+    """Return the *actual* byte offset (stored unit * 8)."""
+    return struct.unpack(">I", b)[0] * NEEDLE_PADDING
+
+
+def new_cookie() -> int:
+    return secrets.randbits(32)
+
+
+@dataclass(frozen=True)
+class FileId:
+    """volumeId,keyHexCookieHex — the public blob address."""
+
+    volume_id: int
+    key: int
+    cookie: int
+
+    def __str__(self) -> str:
+        return f"{self.volume_id},{self.key:x}{self.cookie:08x}"
+
+    @property
+    def needle_id_cookie(self) -> str:
+        return f"{self.key:x}{self.cookie:08x}"
+
+    @classmethod
+    def parse(cls, fid: str) -> "FileId":
+        fid = fid.strip()
+        if "," not in fid:
+            raise ValueError(f"bad fid {fid!r}: missing comma")
+        vid_s, rest = fid.split(",", 1)
+        # optional "_appendDelta" suffix used by chunked uploads
+        delta = 0
+        if "_" in rest:
+            rest, delta_s = rest.split("_", 1)
+            delta = int(delta_s)
+        if len(rest) <= COOKIE_SIZE * 2:
+            raise ValueError(f"bad fid {fid!r}: key+cookie too short")
+        if len(rest) > (NEEDLE_ID_SIZE + COOKIE_SIZE) * 2:
+            raise ValueError(f"bad fid {fid!r}: key+cookie too long")
+        split = len(rest) - COOKIE_SIZE * 2
+        key = int(rest[:split], 16) + delta
+        cookie = int(rest[split:], 16)
+        return cls(volume_id=int(vid_s), key=key, cookie=cookie)

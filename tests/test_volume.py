@@ -1,0 +1,203 @@
+"""Volume engine tests: write/read/delete/overwrite, idx replay, integrity."""
+
+import os
+import struct
+
+import pytest
+
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle, NeedleError, CookieMismatch
+from seaweedfs_tpu.storage.needle_map import NeedleMap, SortedIndex
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+
+
+@pytest.fixture
+def vol(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    yield v
+    v.close()
+
+
+def test_write_read_roundtrip(vol):
+    n = Needle(id=1, cookie=0x11, data=b"alpha", name=b"a.txt")
+    offset, size = vol.write_needle(n)
+    assert offset == 8  # right after superblock
+    got = vol.read_needle(Needle(id=1, cookie=0x11))
+    assert got.data == b"alpha"
+    assert got.name == b"a.txt"
+
+
+def test_read_wrong_cookie_rejected(vol):
+    vol.write_needle(Needle(id=1, cookie=0x11, data=b"x"))
+    with pytest.raises(CookieMismatch):
+        vol.read_needle(Needle(id=1, cookie=0x99))
+
+
+def test_overwrite_requires_same_cookie(vol):
+    vol.write_needle(Needle(id=1, cookie=0x11, data=b"v1"))
+    with pytest.raises(CookieMismatch):
+        vol.write_needle(Needle(id=1, cookie=0x22, data=b"v2"))
+    vol.write_needle(Needle(id=1, cookie=0x11, data=b"v2"))
+    assert vol.read_needle(Needle(id=1, cookie=0x11)).data == b"v2"
+
+
+def test_delete_then_read_fails(vol):
+    vol.write_needle(Needle(id=1, cookie=0x11, data=b"gone"))
+    freed = vol.delete_needle(Needle(id=1, cookie=0x11))
+    assert freed > 0
+    with pytest.raises(NeedleError):
+        vol.read_needle(Needle(id=1, cookie=0x11))
+    # double delete is a no-op
+    assert vol.delete_needle(Needle(id=1, cookie=0x11)) == 0
+
+
+def test_reload_replays_index(tmp_path):
+    v = Volume(str(tmp_path), "", 2)
+    for i in range(10):
+        v.write_needle(Needle(id=i + 1, cookie=7, data=f"data{i}".encode()))
+    v.delete_needle(Needle(id=3, cookie=7))
+    v.close()
+
+    v2 = Volume(str(tmp_path), "", 2, create_if_missing=False)
+    assert v2.file_count == 9
+    assert v2.read_needle(Needle(id=5, cookie=7)).data == b"data4"
+    with pytest.raises(NeedleError):
+        v2.read_needle(Needle(id=3, cookie=7))
+    v2.close()
+
+
+def test_torn_tail_truncated_on_load(tmp_path):
+    v = Volume(str(tmp_path), "", 3)
+    v.write_needle(Needle(id=1, cookie=1, data=b"keep me"))
+    v.close()
+    good_size = os.path.getsize(v.dat_path)
+    with open(v.dat_path, "ab") as f:
+        f.write(b"torn garbage bytes")
+    v2 = Volume(str(tmp_path), "", 3, create_if_missing=False)
+    assert os.path.getsize(v2.dat_path) == good_size
+    assert v2.read_needle(Needle(id=1, cookie=1)).data == b"keep me"
+    v2.close()
+
+
+def test_scan_needles(vol):
+    for i in range(5):
+        vol.write_needle(Needle(id=i + 1, cookie=1, data=b"x%d" % i))
+    vol.delete_needle(Needle(id=2, cookie=1))
+    seen = [n.id for _, n in vol.scan_needles()]
+    assert seen == [1, 2, 3, 4, 5]  # scan sees the original records
+    with_deleted = [n.id for _, n in vol.scan_needles(include_deleted=True)]
+    assert with_deleted == [1, 2, 3, 4, 5, 2]  # plus the delete marker
+
+
+def test_garbage_ratio_grows(vol):
+    for i in range(10):
+        vol.write_needle(Needle(id=i + 1, cookie=1, data=b"y" * 100))
+    assert vol.garbage_ratio() == 0.0
+    for i in range(5):
+        vol.delete_needle(Needle(id=i + 1, cookie=1))
+    assert vol.garbage_ratio() > 0.2
+
+
+def test_delete_wrong_cookie_rejected(vol):
+    vol.write_needle(Needle(id=1, cookie=0x11, data=b"safe"))
+    with pytest.raises(CookieMismatch):
+        vol.delete_needle(Needle(id=1, cookie=0x99))
+    assert vol.read_needle(Needle(id=1, cookie=0x11)).data == b"safe"
+
+
+def test_zero_byte_write_rejected(vol):
+    with pytest.raises(VolumeError):
+        vol.write_needle(Needle(id=1, cookie=0x11, data=b""))
+
+
+def test_missing_idx_does_not_truncate_dat(tmp_path):
+    v = Volume(str(tmp_path), "", 9)
+    v.write_needle(Needle(id=1, cookie=1, data=b"precious"))
+    v.close()
+    os.remove(v.idx_path)
+    dat_size = os.path.getsize(v.dat_path)
+    v2 = Volume(str(tmp_path), "", 9, create_if_missing=False)
+    assert os.path.getsize(v2.dat_path) == dat_size  # data preserved
+    v2.close()
+
+
+def test_torn_idx_tail_truncated(tmp_path):
+    v = Volume(str(tmp_path), "", 10)
+    v.write_needle(Needle(id=1, cookie=1, data=b"aaa"))
+    v.close()
+    with open(v.idx_path, "ab") as f:
+        f.write(b"\x00" * 7)  # torn partial entry
+    v2 = Volume(str(tmp_path), "", 10, create_if_missing=False)
+    v2.write_needle(Needle(id=2, cookie=1, data=b"bbb"))
+    v2.close()
+    v3 = Volume(str(tmp_path), "", 10, create_if_missing=False)
+    assert v3.read_needle(Needle(id=1, cookie=1)).data == b"aaa"
+    assert v3.read_needle(Needle(id=2, cookie=1)).data == b"bbb"
+    assert os.path.getsize(v3.idx_path) % 16 == 0
+    v3.close()
+
+
+def test_idx_entry_roundtrip():
+    b = idx_codec.entry_to_bytes(0xDEADBEEF, 1024, 500)
+    key, off, size = idx_codec.parse_entry(b)
+    assert (key, off, size) == (0xDEADBEEF, 1024, 500)
+    b2 = idx_codec.entry_to_bytes(1, 8, t.TOMBSTONE_SIZE)
+    _, _, size2 = idx_codec.parse_entry(b2)
+    assert size2 == t.TOMBSTONE_SIZE
+
+
+def test_needle_map_metrics(tmp_path):
+    p = str(tmp_path / "m.idx")
+    nm = NeedleMap(p)
+    nm.put(1, 8, 100)
+    nm.put(2, 128, 200)
+    nm.put(1, 256, 150)  # overwrite
+    assert nm.file_count == 3
+    assert nm.deleted_count == 1
+    assert nm.deleted_size == 100
+    nm.delete(2, 512)
+    assert nm.get(2) is None
+    nm.close()
+    nm2 = NeedleMap(p)
+    assert nm2.get(1).size == 150
+    assert nm2.get(2) is None
+    assert nm2.max_key == 2
+    nm2.close()
+
+
+def test_sorted_index_binary_search():
+    entries = b"".join(
+        idx_codec.entry_to_bytes(k, k * 8, 10 + k) for k in [2, 5, 9, 100])
+    si = SortedIndex(entries)
+    assert si.find(5) == (1, 40, 15)
+    assert si.find(4) is None
+    assert si.find(100)[2] == 110
+
+
+def test_store_heartbeat(tmp_path):
+    s = Store([str(tmp_path / "d1"), str(tmp_path / "d2")], ip="127.0.0.1", port=8080)
+    s.add_volume(1)
+    s.add_volume(2, collection="pics", replica_placement="001")
+    s.write_needle(1, Needle(id=1, cookie=1, data=b"hb"))
+    hb = s.collect_heartbeat()
+    assert len(hb["volumes"]) == 2
+    assert hb["max_volume_count"] == 16
+    assert len(hb["new_volumes"]) == 2
+    hb2 = s.collect_heartbeat()
+    assert hb2["new_volumes"] == []  # deltas drained
+    pics = [v for v in hb["volumes"] if v["collection"] == "pics"][0]
+    assert pics["replica_placement"] == 1
+    s.close()
+
+
+def test_store_readonly(tmp_path):
+    s = Store([str(tmp_path)])
+    s.add_volume(1)
+    s.mark_volume_readonly(1)
+    with pytest.raises(VolumeError):
+        s.write_needle(1, Needle(id=1, cookie=1, data=b"no"))
+    s.mark_volume_writable(1)
+    s.write_needle(1, Needle(id=1, cookie=1, data=b"yes"))
+    s.close()
