@@ -1,0 +1,22 @@
+"""Erasure-coding pipeline: RS(10,4) striping of volumes across 14 shards.
+
+Disk layout (parity with reference weed/storage/erasure_coding):
+  .ec00...ec13  shard files: row-major striping of the .dat — 1GB blocks
+                per shard per "large row" while >10GB remains, then 1MB
+                "small rows" (zero-padded tail)
+  .ecx          key-sorted 16-byte needle index (same entry codec as .idx)
+  .ecj          journal of deleted needle ids (8B big-endian each)
+
+The encode/rebuild/decode compute runs as a batched GF(2^8) bit-matmul on
+TPU (seaweedfs_tpu/ops) — many 256KB stripes per dispatch — with CPU
+fallbacks for small volumes.
+"""
+
+from seaweedfs_tpu.ec.locate import Interval, locate_data
+from seaweedfs_tpu.ec.shard_bits import ShardBits
+from seaweedfs_tpu.ec.encoder import (
+    write_ec_files, write_sorted_file_from_idx, rebuild_ec_files,
+    write_dat_file, write_idx_file_from_ec_index, find_dat_file_size,
+    rebuild_ecx_file, shard_file_name, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
+)
+from seaweedfs_tpu.ec.ec_volume import EcVolume, EcVolumeShard

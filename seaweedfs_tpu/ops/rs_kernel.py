@@ -86,10 +86,58 @@ def _m2_device(matrix_bytes: bytes, rows: int, cols: int) -> jnp.ndarray:
 def apply_matrix(matrix: np.ndarray, shards) -> np.ndarray:
     """Host-friendly entry: GF(2^8) matrix [O, S] applied to [..., S, N] bytes.
 
-    Expands the matrix to bits (cached per matrix), runs the jitted kernel
-    on the default backend, and returns a host uint8 array.
+    Expands the matrix to bits (cached per matrix) and runs the jitted
+    kernel. Leading batch dims are flattened into the lane (N) dimension
+    before dispatch — the map is per-byte-column, so [B, S, N] and
+    [S, B*N] are the same computation, and the 2D shape keeps XLA in its
+    well-tiled matmul path (batched 3D int8 einsums compile poorly).
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     m2 = _m2_device(matrix.tobytes(), matrix.shape[0], matrix.shape[1])
-    out = _gf_linear_jit(m2, jnp.asarray(shards, dtype=jnp.uint8))
-    return np.asarray(out)
+    shards = np.asarray(shards, dtype=np.uint8)
+    batch_shape = shards.shape[:-2]
+    s, n = shards.shape[-2:]
+    o = matrix.shape[0]
+    if n == 0:
+        return np.zeros(batch_shape + (o, 0), dtype=np.uint8)
+    if batch_shape:
+        flat = np.ascontiguousarray(
+            np.moveaxis(shards.reshape((-1, s, n)), 1, 0)).reshape(s, -1)
+    else:
+        flat = shards
+    out = _dispatch_slabs(m2, flat, o)
+    if batch_shape:
+        out = np.moveaxis(out.reshape(o, -1, n), 0, 1).reshape(
+            batch_shape + (o, n))
+    return out
+
+
+# Dispatch in fixed, power-of-two lane widths. Every distinct shape costs
+# an XLA compile (slow over the remote-compile tunnel, and some large odd
+# shapes compile pathologically), so we bucket: tails are zero-padded up
+# to the next bucket — harmless, since GF maps send 0 to 0 and the padded
+# columns are simply sliced off.
+_MIN_SLAB = 1 << 16   # 64KB
+_MAX_SLAB = 1 << 22   # 4MB lanes per dispatch (40MB data for S=10)
+
+
+def _dispatch_slabs(m2: jnp.ndarray, flat: np.ndarray, o: int) -> np.ndarray:
+    s, n = flat.shape
+    if n == 0:
+        return np.zeros((o, 0), dtype=np.uint8)
+    out = np.empty((o, n), dtype=np.uint8)
+    pos = 0
+    while pos < n:
+        want = min(n - pos, _MAX_SLAB)
+        slab = _MIN_SLAB
+        while slab < want:
+            slab <<= 1
+        chunk = flat[:, pos:pos + want]
+        if want < slab:
+            padded = np.zeros((s, slab), dtype=np.uint8)
+            padded[:, :want] = chunk
+            chunk = padded
+        res = np.asarray(_gf_linear_jit(m2, jnp.asarray(chunk)))
+        out[:, pos:pos + want] = res[:, :want]
+        pos += want
+    return out
