@@ -1,0 +1,103 @@
+"""Headline benchmark: EC encode throughput, TPU vs CPU baseline.
+
+Measures the RS(10,4) GF(2^8) encode kernel — the compute behind
+`ec.encode` (reference: /root/reference
+weed/storage/erasure_coding/ec_encoder.go:162-192, whose kernel is
+klauspost/reedsolomon's SIMD encoder; our CPU stand-in is the C++ AVX2
+library in seaweedfs_tpu/native).
+
+On-device timing discipline: one dispatch per timed repetition, with
+ITERS encodes chained inside a single jit via lax.fori_loop (each
+iteration's input depends on the loop index so XLA cannot hoist the
+matmul), and only a small checksum fetched back — per the measurement
+notes in .claude/skills/verify/SKILL.md (tunnel costs ~79 ms/round-trip;
+anything per-call under 100 ms measures the tunnel).
+
+Prints ONE json line:
+  {"metric": "ec_encode_gbps", "value": <TPU GB/s>, "unit": "GB/s",
+   "vs_baseline": <ratio vs native CPU single-thread>}
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+DATA_SHARDS = 10
+LANES = 32 << 20          # 32MB lanes -> 320MB data per encode
+ITERS = 16                # encodes chained per dispatch
+REPS = 3                  # timed dispatches; best taken
+CPU_LANES = 8 << 20       # 80MB for the CPU baseline measurement
+
+
+def tpu_gbps() -> float:
+    import jax
+    import jax.numpy as jnp
+    from seaweedfs_tpu.ops.rs_kernel import gf_linear, parity_m2_bits
+
+    m2 = parity_m2_bits()
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(
+        0, 256, size=(DATA_SHARDS, LANES), dtype=np.uint8))
+
+    @jax.jit
+    def run(m2, data):
+        def body(i, acc):
+            d = data ^ i.astype(jnp.uint8)   # loop-variant: no hoisting
+            parity = gf_linear(m2, d)
+            return acc ^ parity[0, 0]
+        return jax.lax.fori_loop(
+            0, ITERS, body, jnp.uint8(0))
+
+    run(m2, data).block_until_ready()        # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        run(m2, data).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    total_bytes = DATA_SHARDS * LANES * ITERS
+    return total_bytes / best / 1e9
+
+
+def cpu_gbps() -> tuple[float, str]:
+    from seaweedfs_tpu.native import rs_native
+    if not rs_native.available():
+        r = subprocess.run(
+            ["make", "-C", os.path.join(REPO_ROOT, "seaweedfs_tpu/native")],
+            capture_output=True)
+        if r.returncode != 0:
+            print(r.stderr.decode(errors="replace"), file=sys.stderr)
+    from seaweedfs_tpu.ops.rs_code import ReedSolomon
+    backend = "native" if rs_native.available() else "numpy"
+    rs = ReedSolomon(backend=backend)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(DATA_SHARDS, CPU_LANES), dtype=np.uint8)
+    rs.encode(data)  # warm (table setup, page-in)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rs.encode(data)
+        best = min(best, time.perf_counter() - t0)
+    return DATA_SHARDS * CPU_LANES / best / 1e9, backend
+
+
+def main() -> None:
+    cpu, cpu_backend = cpu_gbps()
+    tpu = tpu_gbps()
+    print(json.dumps({
+        "metric": "ec_encode_gbps",
+        "value": round(tpu, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tpu / cpu, 3),
+        "baseline_backend": cpu_backend,
+        "baseline_gbps": round(cpu, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

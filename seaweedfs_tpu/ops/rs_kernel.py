@@ -83,6 +83,23 @@ def _m2_device(matrix_bytes: bytes, rows: int, cols: int) -> jnp.ndarray:
     return jnp.asarray(gf256.gf256_matrix_to_gf2(m).astype(np.int8))
 
 
+def m2_bits(matrix: np.ndarray) -> jnp.ndarray:
+    """GF(2^8) matrix [O, S] -> device GF(2) bit-matrix [O*8, S*8] int8.
+
+    The shared entry for every caller that feeds gf_linear directly
+    (parallel/mesh.py, bench.py, __graft_entry__.py) — one place owns the
+    bit ordering and the int8-for-MXU dtype choice.
+    """
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    return _m2_device(matrix.tobytes(), *matrix.shape)
+
+
+def parity_m2_bits() -> jnp.ndarray:
+    """Bit-matrix [32, 80] of the RS(10,4) parity rows."""
+    from seaweedfs_tpu.ops.rs_code import coding_matrix, DATA_SHARDS
+    return m2_bits(np.asarray(coding_matrix())[DATA_SHARDS:])
+
+
 def apply_matrix(matrix: np.ndarray, shards) -> np.ndarray:
     """Host-friendly entry: GF(2^8) matrix [O, S] applied to [..., S, N] bytes.
 
