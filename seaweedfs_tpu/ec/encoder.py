@@ -144,16 +144,19 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:
 # --- rebuild ----------------------------------------------------------------
 
 def rebuild_ec_files(base_name: str, backend: str = "auto",
-                     chunk: int = DEFAULT_CHUNK) -> List[int]:
-    """Regenerate any missing .ecNN from >=10 present ones.
+                     chunk: int = DEFAULT_CHUNK,
+                     wanted: Optional[List[int]] = None) -> List[int]:
+    """Regenerate missing .ecNN from >=10 present ones.
 
-    Returns the list of generated shard ids (reference
-    generateMissingEcFiles, ec_encoder.go:88-118).
+    `wanted` restricts which missing shards get rebuilt (decode-to-volume
+    only needs the data shards). Returns the generated shard ids
+    (reference generateMissingEcFiles, ec_encoder.go:88-118).
     """
     rs = _rs(backend)
     present = [i for i in range(TOTAL_SHARDS)
                if os.path.exists(shard_file_name(base_name, i))]
-    missing = [i for i in range(TOTAL_SHARDS) if i not in present]
+    missing = [i for i in (range(TOTAL_SHARDS) if wanted is None else wanted)
+               if i not in present]
     if not missing:
         return []
     if len(present) < DATA_SHARDS:

@@ -1,0 +1,204 @@
+"""Store-level EC operations: the volume server's EC surface.
+
+Functional equivalents of the reference's store_ec.go /
+store_ec_delete.go and the per-RPC handlers in
+server/volume_grpc_erasure_coding.go:38-400 — generate, rebuild,
+mount/unmount, shard reads, EC needle reads with live recovery, decode
+back to a normal volume. All take the Store as first arg; the Store
+stays EC-agnostic (the ec package plugs into DiskLocation.ec_volumes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional
+
+from seaweedfs_tpu.ec import encoder
+from seaweedfs_tpu.ec.ec_volume import EcVolume, EcShardNotFound
+from seaweedfs_tpu.ec.shard_bits import TOTAL_SHARDS
+from seaweedfs_tpu.ops.rs_code import ReedSolomon
+from seaweedfs_tpu.storage.needle import Needle, NeedleError
+from seaweedfs_tpu.storage.store import Store
+
+
+def _base_name(directory: str, collection: str, vid: int) -> str:
+    name = f"{collection}_{vid}" if collection else str(vid)
+    return os.path.join(directory, name)
+
+
+def _find_ec_base(store: Store, vid: int,
+                  collection: Optional[str] = None) -> Optional[str]:
+    """Locate the <base>.ecx for a volume across disk locations.
+
+    A mounted EcVolume is authoritative for the collection name; when
+    collection is unknown the directories are scanned for any
+    [collection_]vid.ecx match (the same discovery rule
+    DiskLocation._load_ec_shards uses)."""
+    ecv = store.find_ec_volume(vid)
+    if ecv is not None and os.path.exists(ecv.base_name + ".ecx"):
+        return ecv.base_name
+    for loc in store.locations:
+        if collection is not None:
+            base = _base_name(loc.directory, collection, vid)
+            if os.path.exists(base + ".ecx"):
+                return base
+            continue
+        for name in os.listdir(loc.directory):
+            if not name.endswith(".ecx"):
+                continue
+            stem = name[:-len(".ecx")]
+            col, _, tail = stem.rpartition("_")
+            if tail == str(vid) or (not col and stem == str(vid)):
+                return os.path.join(loc.directory, stem)
+    return None
+
+
+def generate_ec_shards(store: Store, vid: int, backend: str = "auto") -> str:
+    """VolumeEcShardsGenerate: .dat/.idx -> .ec00-13 + .ecx.
+
+    The volume must exist locally; it is marked read-only first (the
+    shell's ec.encode does this cluster-wide before calling in).
+    Returns the base name the shard files were written under.
+    """
+    v = store.find_volume(vid)
+    if v is None:
+        raise NeedleError(f"volume {vid} not found for ec encode")
+    v.read_only = True
+    v.sync()
+    base = v.file_name()
+    encoder.write_ec_files(base, backend=backend)
+    encoder.write_sorted_file_from_idx(base)
+    return base
+
+
+def rebuild_ec_shards(store: Store, vid: int, collection: Optional[str] = None,
+                      backend: str = "auto") -> List[int]:
+    """VolumeEcShardsRebuild: regenerate missing .ecNN from >=10 local
+    ones. Returns rebuilt shard ids."""
+    base = _find_ec_base(store, vid, collection)
+    if base is None:
+        raise EcShardNotFound(f"no local ec files for volume {vid}")
+    return encoder.rebuild_ec_files(base, backend=backend)
+
+
+def mount_ec_shards(store: Store, vid: int, collection: str,
+                    shard_ids: Iterable[int]) -> EcVolume:
+    """VolumeEcShardsMount: open shard files and register the EcVolume."""
+    base = _find_ec_base(store, vid, collection)
+    if base is None:
+        raise EcShardNotFound(f"volume {vid}: no .ecx on any disk location")
+    loc = next(l for l in store.locations
+               if os.path.dirname(base) == l.directory)
+    ecv = loc.ec_volumes.get(vid)
+    if ecv is None:
+        ecv = EcVolume(loc.directory, collection, vid)
+        loc.ec_volumes[vid] = ecv
+    for sid in shard_ids:
+        ecv.mount_shard(sid)
+    return ecv
+
+
+def unmount_ec_shards(store: Store, vid: int,
+                      shard_ids: Iterable[int]) -> None:
+    """VolumeEcShardsUnmount; drops the EcVolume when no shards remain."""
+    ecv = store.find_ec_volume(vid)
+    if ecv is None:
+        return
+    for sid in shard_ids:
+        ecv.unmount_shard(sid)
+    if not ecv.shards:
+        loc = store.location_of(vid)
+        ecv.close()
+        if loc is not None:
+            loc.ec_volumes.pop(vid, None)
+
+
+def delete_ec_shards(store: Store, vid: int, collection: Optional[str] = None,
+                     shard_ids: Iterable[int] = ()) -> None:
+    """VolumeEcShardsDelete: remove shard files; when none remain, the
+    .ecx/.ecj go too (reference volume_grpc_erasure_coding.go:136-210)."""
+    base = _find_ec_base(store, vid, collection)
+    if base is None:
+        return
+    ecv = store.find_ec_volume(vid)
+    for sid in shard_ids:
+        if ecv is not None:
+            ecv.unmount_shard(sid)
+        p = encoder.shard_file_name(base, sid)
+        if os.path.exists(p):
+            os.remove(p)
+    if not any(os.path.exists(encoder.shard_file_name(base, i))
+               for i in range(TOTAL_SHARDS)):
+        loc = next(l for l in store.locations
+                   if os.path.dirname(base) == l.directory)
+        if ecv is not None:
+            ecv.close()
+            loc.ec_volumes.pop(vid, None)
+        for ext in (".ecx", ".ecj"):
+            if os.path.exists(base + ext):
+                os.remove(base + ext)
+
+
+def read_ec_shard(store: Store, vid: int, shard_id: int, offset: int,
+                  length: int) -> bytes:
+    """VolumeEcShardRead: raw bytes of one local shard (serves remote
+    peers' interval reads)."""
+    ecv = store.find_ec_volume(vid)
+    if ecv is None:
+        raise EcShardNotFound(f"ec volume {vid} not mounted")
+    shard = ecv.shards.get(shard_id)
+    if shard is None:
+        raise EcShardNotFound(f"ec volume {vid} shard {shard_id} not local")
+    return shard.read_at(offset, length)
+
+
+def read_ec_needle(store: Store, vid: int, n: Needle,
+                   remote_reader: Optional[Callable] = None,
+                   rs: Optional[ReedSolomon] = None) -> Needle:
+    """ReadEcShardNeedle: cookie-checked needle read over shards, with
+    remote fan-out and on-the-fly RS recovery (store_ec.go:122-262)."""
+    ecv = store.find_ec_volume(vid)
+    if ecv is None:
+        raise EcShardNotFound(f"ec volume {vid} not mounted")
+    return ecv.read_needle(n, remote_reader=remote_reader, rs=rs)
+
+
+def delete_ec_needle(store: Store, vid: int, n: Needle) -> None:
+    """Tombstone in .ecx + journal to .ecj (store_ec_delete.go)."""
+    ecv = store.find_ec_volume(vid)
+    if ecv is None:
+        raise EcShardNotFound(f"ec volume {vid} not mounted")
+    ecv.delete_needle(n.id)
+
+
+def ec_shards_to_volume(store: Store, vid: int, collection: str = "",
+                        backend: str = "auto",
+                        large_block: int = encoder.LARGE_BLOCK_SIZE,
+                        small_block: int = encoder.SMALL_BLOCK_SIZE) -> None:
+    """VolumeEcShardsToVolume: decode .ec00-09 (+.ecx/.ecj) back into a
+    loadable .dat/.idx volume (reference
+    volume_grpc_erasure_coding.go:360-400 + ec_decoder.go)."""
+    if store.find_ec_volume(vid) is not None:
+        raise EcShardNotFound(
+            f"volume {vid}: unmount ec shards before decoding back "
+            "(a mounted EcVolume would serve stale reads)")
+    base = _find_ec_base(store, vid, collection or None)
+    if base is None:
+        raise EcShardNotFound(f"volume {vid}: no .ecx to decode from")
+    loc = next(l for l in store.locations
+               if os.path.dirname(base) == l.directory)
+    stem = os.path.basename(base)
+    collection = stem.rsplit("_", 1)[0] if "_" in stem else ""
+    # only the data shards are read back; don't waste RS compute
+    # regenerating missing parity
+    encoder.rebuild_ec_files(base, backend=backend,
+                             wanted=list(range(encoder.DATA_SHARDS)))
+    dat_size = encoder.find_dat_file_size(base)
+    encoder.write_dat_file(base, dat_size,
+                           large_block=large_block, small_block=small_block)
+    encoder.write_idx_file_from_ec_index(base)
+    from seaweedfs_tpu.storage.volume import Volume
+    with loc._lock:
+        v = Volume(loc.directory, collection, vid, create_if_missing=False)
+        loc.volumes[vid] = v
+    store.new_volumes.append(store.volume_info(v))

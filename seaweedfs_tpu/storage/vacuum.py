@@ -1,0 +1,183 @@
+"""Vacuum: compact away deleted needles.
+
+Behavioral parity with the reference compaction
+(weed/storage/volume_vacuum.go): live needles are copied into shadow
+files (.cpd/.cpx) while the volume stays writable; commit catches up
+with the writes that landed during compaction (makeupDiff) and then
+atomically renames the shadows into place. The compaction revision in
+the superblock is bumped so replicas can detect a compacted peer.
+
+Crash safety protocol: shadows are fsynced, then .cpd -> .dat is renamed
+BEFORE .cpx -> .idx. At load, recover_compaction() resolves every
+possible crash state from the shadow files left behind:
+
+  .cpd + .cpx present  -> commit never started: drop both (abort).
+  .cpx only            -> crash between the renames: the .dat is already
+                          the compacted one, so finish by renaming
+                          .cpx -> .idx (roll forward).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+from typing import Dict, Tuple
+
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle, NeedleError, actual_size
+from seaweedfs_tpu.storage.superblock import SuperBlock
+from seaweedfs_tpu.storage.volume import Volume
+
+
+@dataclasses.dataclass
+class CompactState:
+    cpd_path: str
+    cpx_path: str
+    scanned_until: int            # .dat offset the compact scan covered
+    new_offsets: Dict[int, Tuple[int, int]]  # key -> (offset in .cpd, size)
+
+
+def compact(v: Volume, preallocate: int = 0) -> CompactState:
+    """Phase 1: copy live needles into <base>.cpd/.cpx.
+
+    Runs without blocking the write path (scan uses its own fd; the
+    needle map is only read). Returns the state commit_compact needs.
+    """
+    base = v.file_name()
+    cpd_path, cpx_path = base + ".cpd", base + ".cpx"
+    new_sb = SuperBlock(
+        version=v.version,
+        replica_placement=v.super_block.replica_placement,
+        ttl=v.super_block.ttl,
+        compaction_revision=(v.super_block.compaction_revision + 1) & 0xFFFF,
+    )
+    scanned_until = v.content_size
+    new_offsets: Dict[int, Tuple[int, int]] = {}
+    with open(cpd_path, "wb") as out:
+        out.write(new_sb.to_bytes())
+        pos = out.tell()
+        for offset, n in v.scan_needles():
+            if offset >= scanned_until:
+                # a write landed after the size snapshot; it belongs to
+                # _makeup_diff's replay, not this scan (double-copying it
+                # would leave a phantom duplicate in the new index)
+                break
+            nv = v.nm.get(n.id)
+            # only the *live* copy of a needle is kept: the map points at
+            # the newest record; older overwrites and tombstoned ids drop
+            if nv is None or nv.offset != offset or not t.size_is_valid(nv.size):
+                continue
+            blob = n.to_bytes(v.version)
+            if pos % t.NEEDLE_PADDING:
+                pad = t.NEEDLE_PADDING - pos % t.NEEDLE_PADDING
+                out.write(b"\x00" * pad)
+                pos += pad
+            out.write(blob)
+            new_offsets[n.id] = (pos, n.size)
+            pos += len(blob)
+    with open(cpx_path, "wb") as out:
+        for key, (offset, size) in new_offsets.items():
+            out.write(idx_codec.entry_to_bytes(key, offset, size))
+    return CompactState(cpd_path, cpx_path, scanned_until, new_offsets)
+
+
+def commit_compact(v: Volume, state: CompactState) -> None:
+    """Phase 2: fold in post-scan writes, swap shadows into place, reload."""
+    with v._lock:
+        v.sync()
+        _makeup_diff(v, state)
+        for p in (state.cpd_path, state.cpx_path):
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        v._dat.close()
+        v.nm.close()
+        # .cpd first: if we crash between the renames, a .cpx without a
+        # .cpd tells recover_compaction the .dat is already compacted
+        os.replace(state.cpd_path, v.dat_path)
+        os.replace(state.cpx_path, v.idx_path)
+        v._load()
+
+
+def recover_compaction(base_name: str) -> None:
+    """Resolve shadow files left by a crash mid-vacuum (see module
+    docstring for the state machine). Safe to call on every load."""
+    cpd, cpx = base_name + ".cpd", base_name + ".cpx"
+    if os.path.exists(cpd):
+        # commit never reached the renames: abort the compaction
+        os.remove(cpd)
+        if os.path.exists(cpx):
+            os.remove(cpx)
+    elif os.path.exists(cpx):
+        # crashed between the renames: .dat is compacted, finish the job
+        os.replace(cpx, base_name + ".idx")
+
+
+def _makeup_diff(v: Volume, state: CompactState) -> None:
+    """Replay .dat records appended after the compact scan onto the
+    shadows (reference makeupDiff, volume_vacuum.go:179)."""
+    dat_size = v.content_size
+    if dat_size <= state.scanned_until:
+        return
+    with open(v.dat_path, "rb") as f, \
+            open(state.cpd_path, "r+b") as cpd, \
+            open(state.cpx_path, "ab") as cpx:
+        cpd.seek(0, os.SEEK_END)
+        offset = _align(state.scanned_until)
+        while offset + t.NEEDLE_HEADER_SIZE <= dat_size:
+            f.seek(offset)
+            header = f.read(t.NEEDLE_HEADER_SIZE)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                break
+            cookie, nid, size_u = struct.unpack(">IQI", header)
+            body_size = t.size_to_int32(size_u)
+            if t.size_is_deleted(body_size):
+                body_size = 0
+            length = actual_size(body_size, v.version)
+            f.seek(offset)
+            blob = f.read(length)
+            if len(blob) < length:
+                break
+            try:
+                n = Needle.from_bytes(blob, v.version, check_crc=False)
+            except NeedleError:
+                offset += length
+                continue
+            if len(n.data) == 0:
+                # delete marker: tombstone the id in the shadow index
+                if nid in state.new_offsets:
+                    del state.new_offsets[nid]
+                cpx.write(idx_codec.entry_to_bytes(
+                    nid, 0, t.TOMBSTONE_SIZE))
+            else:
+                pos = _align(cpd.tell())
+                if pos != cpd.tell():
+                    cpd.write(b"\x00" * (pos - cpd.tell()))
+                cpd.write(blob)
+                state.new_offsets[nid] = (pos, n.size)
+                cpx.write(idx_codec.entry_to_bytes(nid, pos, n.size))
+            offset += length
+    state.scanned_until = dat_size
+
+
+def _align(pos: int) -> int:
+    if pos % t.NEEDLE_PADDING:
+        return pos + t.NEEDLE_PADDING - pos % t.NEEDLE_PADDING
+    return pos
+
+
+def vacuum_volume(v: Volume, garbage_threshold: float = 0.3) -> bool:
+    """Compact + commit if the garbage ratio clears the threshold.
+
+    The one-call form the volume server's vacuum RPC and the master's
+    scheduled vacuum driver use (reference topology_vacuum.go:147).
+    """
+    if v.garbage_ratio() <= garbage_threshold:
+        return False
+    state = compact(v)
+    commit_compact(v, state)
+    return True

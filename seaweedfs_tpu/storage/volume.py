@@ -83,6 +83,8 @@ class Volume:
     # -- loading / integrity -------------------------------------------------
 
     def _load(self) -> None:
+        from seaweedfs_tpu.storage.vacuum import recover_compaction
+        recover_compaction(self.file_name())
         self._dat = open(self.dat_path, "r+b")
         header = self._dat.read(8)
         if len(header) < 8:
