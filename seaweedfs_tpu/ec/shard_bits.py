@@ -5,9 +5,7 @@ Reference: weed/storage/erasure_coding/ec_volume_info.go:61-113.
 
 from __future__ import annotations
 
-DATA_SHARDS = 10
-PARITY_SHARDS = 4
-TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS
 
 
 class ShardBits(int):

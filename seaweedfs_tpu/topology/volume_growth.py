@@ -1,0 +1,87 @@
+"""VolumeGrowth: choose servers for new volume replicas honoring the
+xyz replica placement (x = other data centers, y = other racks in the
+same DC, z = other servers in the same rack).
+
+Reference: weed/topology/volume_growth.go:70-240. The selection is
+re-expressed as explicit candidate filtering + weighted sampling over
+free slots instead of the reference's randomized node-walk callbacks.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+from seaweedfs_tpu.topology.node import DataNode
+
+
+# how many volumes to grow per request, by total replica count
+# (reference volume_growth.go:30-45: more replicas -> grow fewer at once)
+def growth_count(copy_count: int) -> int:
+    return {1: 7, 2: 6, 3: 3}.get(copy_count, 1)
+
+
+class NoFreeSlots(Exception):
+    pass
+
+
+class VolumeGrowth:
+    def __init__(self, topology):
+        self.topo = topology
+
+    def find_empty_slots(self, rp: ReplicaPlacement,
+                         data_center: str = "") -> List[DataNode]:
+        """Pick copy_count() nodes satisfying the placement grammar.
+
+        Strategy: pick the main rack server cluster first (1 + same_rack
+        servers in one rack, each on a distinct node), then same_dc
+        racks, then other DCs — mirroring findEmptySlotsForOneVolume.
+        """
+        dcs = list(self.topo.data_centers.values())
+        if data_center:
+            dcs = [dc for dc in dcs if dc.id == data_center]
+        random.shuffle(dcs)
+        for dc in dcs:
+            picked = self._try_dc(dc, rp)
+            if picked is not None:
+                return picked
+        raise NoFreeSlots(
+            f"no placement for {rp}: not enough free slots spread over "
+            f"{'dc ' + data_center if data_center else 'the cluster'}")
+
+    def _try_dc(self, dc, rp: ReplicaPlacement) -> Optional[List[DataNode]]:
+        racks = [r for r in dc.racks.values() if r.free_slots() > 0]
+        random.shuffle(racks)
+        for main_rack in racks:
+            nodes = [n for n in main_rack.nodes.values() if n.free_slots() > 0]
+            if len(nodes) < 1 + rp.same_rack:
+                continue
+            main_nodes = random.sample(nodes, 1 + rp.same_rack)
+            # other racks in this DC
+            other_racks = [r for r in racks if r is not main_rack]
+            if len(other_racks) < rp.diff_rack:
+                continue
+            rack_nodes = []
+            for r in random.sample(other_racks, rp.diff_rack):
+                cands = [n for n in r.nodes.values() if n.free_slots() > 0]
+                if not cands:
+                    break
+                rack_nodes.append(random.choice(cands))
+            if len(rack_nodes) < rp.diff_rack:
+                continue
+            # other DCs
+            other_dcs = [d for d in self.topo.data_centers.values()
+                         if d is not dc and d.free_slots() > 0]
+            if len(other_dcs) < rp.diff_dc:
+                continue
+            dc_nodes = []
+            for d in random.sample(other_dcs, rp.diff_dc):
+                cands = [n for n in d.nodes() if n.free_slots() > 0]
+                if not cands:
+                    break
+                dc_nodes.append(random.choice(cands))
+            if len(dc_nodes) < rp.diff_dc:
+                continue
+            return main_nodes + rack_nodes + dc_nodes
+        return None

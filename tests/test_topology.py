@@ -1,0 +1,212 @@
+"""Topology / placement logic over fabricated cluster views — the house
+pattern from the reference's topology_test.go / volume_growth_test.go:
+no servers, just synthetic heartbeats."""
+
+import pytest
+
+from seaweedfs_tpu.ec.shard_bits import ShardBits
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+from seaweedfs_tpu.topology import Topology, VolumeGrowth
+from seaweedfs_tpu.topology.volume_growth import NoFreeSlots
+
+
+def hb(ip, port, volumes=(), ec=(), max_count=8, max_key=0):
+    return {
+        "ip": ip, "port": port, "public_url": f"{ip}:{port}",
+        "max_volume_count": max_count,
+        "volumes": list(volumes), "ec_shards": list(ec),
+        "max_file_key": max_key,
+    }
+
+
+def vol(vid, size=0, collection="", rp=0, read_only=False):
+    return {"id": vid, "collection": collection, "size": size,
+            "file_count": 1, "delete_count": 0, "deleted_byte_count": 0,
+            "read_only": read_only, "replica_placement": rp, "ttl": "",
+            "version": 3}
+
+
+def build_cluster(topo, n_dcs=2, racks_per_dc=2, nodes_per_rack=3):
+    port = 8080
+    for d in range(n_dcs):
+        for r in range(racks_per_dc):
+            for n in range(nodes_per_rack):
+                topo.sync_heartbeat(
+                    hb(f"10.{d}.{r}.{n}", port),
+                    dc=f"dc{d}", rack=f"rack{d}{r}")
+    return topo
+
+
+def test_heartbeat_registers_and_lookup():
+    topo = Topology()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(3, size=100)]))
+    locs = topo.lookup(3)
+    assert [n.url for n in locs] == ["10.0.0.1:8080"]
+    assert topo.sequence.peek == 1
+
+
+def test_heartbeat_sequence_floor():
+    topo = Topology()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, max_key=500))
+    assert topo.sequence.next_batch() == 501
+
+
+def test_pick_for_write_and_fid_format():
+    topo = Topology()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(7)]))
+    fid, count, locs = topo.pick_for_write()
+    vid, rest = fid.split(",")
+    assert vid == "7" and count == 1
+    assert len(rest) >= 9  # key hex + 8 cookie hex chars
+    assert locs[0].url == "10.0.0.1:8080"
+
+
+def test_writable_requires_full_replica_count():
+    topo = Topology()
+    rp = ReplicaPlacement.parse("001").to_byte()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(5, rp=rp)]))
+    assert topo.pick_for_write(replica_byte=rp) is None  # 1 of 2 replicas
+    topo.sync_heartbeat(hb("10.0.0.2", 8080, volumes=[vol(5, rp=rp)]))
+    assert topo.pick_for_write(replica_byte=rp) is not None
+
+
+def test_readonly_and_oversized_excluded():
+    topo = Topology(volume_size_limit=1000)
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[
+        vol(1, read_only=True), vol(2, size=2000), vol(3)]))
+    vl = topo.layout_for("", 0, "")
+    assert vl.writable == {3}
+
+
+def test_node_loss_unregisters_volumes():
+    topo = Topology()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1)]))
+    topo.sync_heartbeat(hb("10.0.0.2", 8080, volumes=[vol(1)]))
+    topo.unregister_node("10.0.0.1:8080")
+    assert [n.url for n in topo.lookup(1)] == ["10.0.0.2:8080"]
+    topo.unregister_node("10.0.0.2:8080")
+    assert topo.lookup(1) == []
+
+
+def test_reap_dead_nodes():
+    topo = Topology(pulse_seconds=0.001)
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(1)]))
+    import time
+    time.sleep(0.02)
+    assert topo.reap_dead_nodes() == ["10.0.0.1:8080"]
+    assert topo.lookup(1) == []
+
+
+def test_ec_shard_registration_and_lookup():
+    topo = Topology()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080,
+                           ec=[{"id": 9, "collection": "",
+                                "ec_index_bits": int(ShardBits.of(0, 1, 2))}]))
+    topo.sync_heartbeat(hb("10.0.0.2", 8080,
+                           ec=[{"id": 9, "collection": "",
+                                "ec_index_bits": int(ShardBits.of(3, 4))}]))
+    locs = topo.lookup_ec(9)
+    assert locs["10.0.0.1:8080"].shard_ids == [0, 1, 2]
+    assert locs["10.0.0.2:8080"].shard_ids == [3, 4]
+    # shards dropped from a later heartbeat disappear
+    topo.sync_heartbeat(hb("10.0.0.2", 8080, ec=[]))
+    assert "10.0.0.2:8080" not in topo.lookup_ec(9)
+
+
+def test_growth_respects_placement_000():
+    topo = build_cluster(Topology(), 1, 1, 1)
+    vg = VolumeGrowth(topo)
+    nodes = vg.find_empty_slots(ReplicaPlacement.parse("000"))
+    assert len(nodes) == 1
+
+
+def test_growth_respects_placement_001_same_rack():
+    topo = build_cluster(Topology(), 1, 1, 3)
+    vg = VolumeGrowth(topo)
+    nodes = vg.find_empty_slots(ReplicaPlacement.parse("001"))
+    assert len(nodes) == 2
+    assert nodes[0].rack is nodes[1].rack
+    assert nodes[0] is not nodes[1]
+
+
+def test_growth_respects_placement_010_diff_rack():
+    topo = build_cluster(Topology(), 1, 2, 2)
+    vg = VolumeGrowth(topo)
+    nodes = vg.find_empty_slots(ReplicaPlacement.parse("010"))
+    assert len(nodes) == 2
+    assert nodes[0].rack is not nodes[1].rack
+    assert nodes[0].rack.data_center is nodes[1].rack.data_center
+
+
+def test_growth_respects_placement_100_diff_dc():
+    topo = build_cluster(Topology(), 2, 1, 2)
+    vg = VolumeGrowth(topo)
+    nodes = vg.find_empty_slots(ReplicaPlacement.parse("100"))
+    assert len(nodes) == 2
+    assert nodes[0].rack.data_center is not nodes[1].rack.data_center
+
+
+def test_growth_mixed_placement_111():
+    topo = build_cluster(Topology(), 2, 2, 2)
+    vg = VolumeGrowth(topo)
+    nodes = vg.find_empty_slots(ReplicaPlacement.parse("111"))
+    assert len(nodes) == 4
+    main_dc = nodes[0].rack.data_center
+    assert nodes[1].rack is nodes[0].rack          # same rack
+    assert nodes[2].rack is not nodes[0].rack      # other rack
+    assert nodes[2].rack.data_center is main_dc
+    assert nodes[3].rack.data_center is not main_dc  # other dc
+
+
+def test_growth_fails_when_impossible():
+    topo = build_cluster(Topology(), 1, 1, 1)
+    vg = VolumeGrowth(topo)
+    with pytest.raises(NoFreeSlots):
+        vg.find_empty_slots(ReplicaPlacement.parse("100"))
+
+
+def test_growth_honors_capacity():
+    topo = Topology()
+    full = hb("10.0.0.1", 8080,
+              volumes=[vol(i) for i in range(1, 9)], max_count=8)
+    topo.sync_heartbeat(full)
+    vg = VolumeGrowth(topo)
+    with pytest.raises(NoFreeSlots):
+        vg.find_empty_slots(ReplicaPlacement.parse("000"))
+
+
+def test_to_map_roundtrip():
+    topo = build_cluster(Topology(), 2, 2, 2)
+    m = topo.to_map()
+    assert len(m["data_centers"]) == 2
+    assert m["free_slots"] == topo.free_slots() > 0
+
+
+def test_existing_volume_state_changes_propagate():
+    topo = Topology(volume_size_limit=1000)
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(4, size=100)]))
+    vl = topo.layout_for("", 0, "")
+    assert 4 in vl.writable
+    # grows past the limit on a later heartbeat -> unwritable
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(4, size=2000)]))
+    assert 4 not in vl.writable
+    # vacuumed back down + no longer read-only -> writable again
+    topo.sync_heartbeat(hb("10.0.0.1", 8080,
+                           volumes=[vol(4, size=50, read_only=True)]))
+    assert 4 not in vl.writable
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, volumes=[vol(4, size=50)]))
+    assert 4 in vl.writable
+
+
+def test_ec_changes_notify_listeners():
+    topo = Topology()
+    events = []
+    topo.listeners.append(lambda: events.append(1))
+    topo.sync_heartbeat(hb("10.0.0.1", 8080,
+                           ec=[{"id": 9, "collection": "",
+                                "ec_index_bits": int(ShardBits.of(0, 1))}]))
+    assert events
+    events.clear()
+    topo.sync_heartbeat(hb("10.0.0.1", 8080, ec=[]))  # shards dropped
+    assert events
+    assert 9 not in topo.ec_collections
