@@ -1,0 +1,27 @@
+"""Generated protobuf modules + stub helpers.
+
+Contract mirrors the reference's weed/pb (master.proto,
+volume_server.proto, filer.proto) in capability; messages are written
+fresh for this framework. Regenerate with pb/gen.sh.
+"""
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.pb import filer_pb2, master_pb2, volume_server_pb2
+
+__all__ = ["master_pb2", "volume_server_pb2", "filer_pb2",
+           "master_stub", "volume_stub", "filer_stub"]
+
+
+def master_stub(url_or_target: str, is_http_url: bool = True):
+    target = rpc.grpc_address(url_or_target) if is_http_url else url_or_target
+    return rpc.make_stub(master_pb2, "Seaweed", target)
+
+
+def volume_stub(url_or_target: str, is_http_url: bool = True):
+    target = rpc.grpc_address(url_or_target) if is_http_url else url_or_target
+    return rpc.make_stub(volume_server_pb2, "VolumeServer", target)
+
+
+def filer_stub(url_or_target: str, is_http_url: bool = True):
+    target = rpc.grpc_address(url_or_target) if is_http_url else url_or_target
+    return rpc.make_stub(filer_pb2, "SeaweedFiler", target)

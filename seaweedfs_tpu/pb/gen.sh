@@ -1,0 +1,9 @@
+#!/bin/sh
+# Regenerate *_pb2.py from proto/*.proto.
+#
+# Only protoc's builtin python generator is needed (no grpc_tools in the
+# image); service stubs are hand-built from the method tables in
+# seaweedfs_tpu/pb/__init__.py instead of *_pb2_grpc.py codegen.
+set -e
+cd "$(dirname "$0")"
+protoc --proto_path=proto --python_out=. proto/master.proto proto/volume_server.proto proto/filer.proto

@@ -1,0 +1,138 @@
+"""gRPC plumbing without codegen'd service stubs.
+
+The image has the grpc runtime and protoc, but not the grpc_tools /
+grpc_python_plugin codegen. Instead of checking in hand-written *_pb2_grpc
+boilerplate, stubs and server handlers are built at import time from the
+service descriptors embedded in the generated *_pb2 modules.
+
+Conventions follow the reference:
+  - gRPC port = HTTP port + 10000 (weed/command/master.go:136)
+  - one cached channel per target address (weed/pb/grpc_client_server.go)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import grpc
+from google.protobuf import message_factory
+
+GRPC_PORT_OFFSET = 10000
+
+_channel_lock = threading.Lock()
+_channels: Dict[str, grpc.Channel] = {}
+
+
+def grpc_address(url: str) -> str:
+    """Map an HTTP "host:port" to its gRPC sibling "host:port+10000"."""
+    if "//" in url:
+        url = url.split("//", 1)[1]
+    host, sep, port = url.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"expected host:port, got {url!r}")
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
+def cached_channel(address: str) -> grpc.Channel:
+    with _channel_lock:
+        ch = _channels.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(
+                address,
+                options=[("grpc.max_send_message_length", 64 << 20),
+                         ("grpc.max_receive_message_length", 64 << 20)])
+            _channels[address] = ch
+        return ch
+
+
+def close_channels() -> None:
+    with _channel_lock:
+        for ch in _channels.values():
+            ch.close()
+        _channels.clear()
+
+
+class _MethodSpec:
+    __slots__ = ("name", "path", "req_cls", "resp_cls",
+                 "client_streaming", "server_streaming")
+
+    def __init__(self, service_desc, method_desc):
+        self.name = method_desc.name
+        self.path = f"/{service_desc.full_name}/{method_desc.name}"
+        self.req_cls = message_factory.GetMessageClass(method_desc.input_type)
+        self.resp_cls = message_factory.GetMessageClass(method_desc.output_type)
+        self.client_streaming = method_desc.client_streaming
+        self.server_streaming = method_desc.server_streaming
+
+
+def _service_specs(pb2_module, service_name: str):
+    svc = pb2_module.DESCRIPTOR.services_by_name[service_name]
+    return svc, [_MethodSpec(svc, m) for m in svc.methods]
+
+
+def make_stub(pb2_module, service_name: str, target: str):
+    """A stub object with one callable per RPC, like codegen'd stubs."""
+    _, specs = _service_specs(pb2_module, service_name)
+    channel = cached_channel(target)
+    stub = type(f"{service_name}Stub", (), {})()
+    for spec in specs:
+        if spec.client_streaming and spec.server_streaming:
+            factory = channel.stream_stream
+        elif spec.client_streaming:
+            factory = channel.stream_unary
+        elif spec.server_streaming:
+            factory = channel.unary_stream
+        else:
+            factory = channel.unary_unary
+        setattr(stub, spec.name, factory(
+            spec.path,
+            request_serializer=spec.req_cls.SerializeToString,
+            response_deserializer=spec.resp_cls.FromString))
+    return stub
+
+
+def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcHandler:
+    """Route RPCs of one service to same-named methods on `servicer`.
+
+    Unimplemented methods raise UNIMPLEMENTED instead of failing at
+    registration, so servers can grow their surface incrementally.
+    """
+    svc, specs = _service_specs(pb2_module, service_name)
+    handlers = {}
+    for spec in specs:
+        fn = getattr(servicer, spec.name, None)
+        if fn is None:
+            def fn(request, context, _name=spec.name):  # noqa: ARG001
+                context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                              f"method {_name} not implemented")
+        if spec.client_streaming and spec.server_streaming:
+            make = grpc.stream_stream_rpc_method_handler
+        elif spec.client_streaming:
+            make = grpc.stream_unary_rpc_method_handler
+        elif spec.server_streaming:
+            make = grpc.unary_stream_rpc_method_handler
+        else:
+            make = grpc.unary_unary_rpc_method_handler
+        handlers[spec.name] = make(fn, request_deserializer=spec.req_cls.FromString,
+                                   response_serializer=spec.resp_cls.SerializeToString)
+    return grpc.method_handlers_generic_handler(svc.full_name, handlers)
+
+
+def make_server(address: str, handlers, max_workers: int = 16) -> grpc.Server:
+    """Build + start a grpc.Server bound to `address` with the given
+    generic handlers (from generic_handler())."""
+    from concurrent import futures
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_send_message_length", 64 << 20),
+                 ("grpc.max_receive_message_length", 64 << 20),
+                 ("grpc.so_reuseport", 0)])
+    for h in handlers:
+        server.add_generic_rpc_handlers((h,))
+    bound = server.add_insecure_port(address)
+    if bound == 0:
+        raise OSError(f"cannot bind grpc server to {address}")
+    server.bound_port = bound  # OS-assigned when address ends in :0
+    server.start()
+    return server
