@@ -1,0 +1,6 @@
+"""Client-side operations: file ids, assign, upload, lookup, delete
+(reference weed/operation)."""
+
+from seaweedfs_tpu.operation.file_id import FileId, format_fid, parse_fid
+
+__all__ = ["FileId", "parse_fid", "format_fid"]

@@ -1,0 +1,1 @@
+"""Server roles: master, volume, filer (reference weed/server)."""

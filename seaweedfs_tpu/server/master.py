@@ -1,0 +1,538 @@
+"""Master server: cluster control plane.
+
+One process owns the Topology, assigns file ids, grows volumes, drives
+vacuum, and feeds every client a live vid->location cache over the
+KeepConnected stream.
+
+Reference: weed/server/master_server.go, master_grpc_server.go
+(SendHeartbeat :20-176, KeepConnected :178-233), master_server_handlers*.go,
+topology/topology_vacuum.go.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+
+import grpc
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Set
+from urllib.parse import parse_qs, urlparse
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.pb import master_pb2, volume_server_pb2, volume_stub
+from seaweedfs_tpu.server import convert
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+from seaweedfs_tpu.topology.sequence import MemorySequencer
+from seaweedfs_tpu.topology.topology import Topology
+from seaweedfs_tpu.topology.volume_growth import NoFreeSlots, VolumeGrowth, growth_count
+
+
+class AdminLock:
+    """Cluster-wide exclusive admin lease (reference
+    wdclient/exclusive_locks + master_grpc_server_admin.go)."""
+
+    RENEW_WINDOW_NS = 10 * 1_000_000_000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._token = 0
+        self._ts_ns = 0
+
+    def lease(self, previous_token: int) -> tuple:
+        now = time.monotonic_ns()
+        with self._lock:
+            held = self._token and now - self._ts_ns < self.RENEW_WINDOW_NS
+            if held and previous_token != self._token:
+                raise PermissionError("admin lock held by another client")
+            self._token = now
+            self._ts_ns = now
+            return self._token, self._ts_ns
+
+    def release(self, previous_token: int) -> None:
+        with self._lock:
+            if previous_token == self._token:
+                self._token = 0
+                self._ts_ns = 0
+
+
+class MasterServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 9333,
+                 meta_dir: Optional[str] = None,
+                 volume_size_limit_mb: int = 30 * 1024,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 garbage_threshold: float = 0.3):
+        self.ip = ip
+        self.port = port
+        self.meta_dir = meta_dir
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        seq = MemorySequencer(start=self._load_sequence())
+        self.topo = Topology(volume_size_limit=volume_size_limit_mb << 20,
+                             sequencer=seq, pulse_seconds=pulse_seconds)
+        self.growth = VolumeGrowth(self.topo)
+        self.admin_lock = AdminLock()
+        self._grpc_server = None
+        self._http_server = None
+        self._http_thread = None
+        self._grow_lock = threading.Lock()
+        # heartbeat stream identity per node url (reconnect-safe cleanup)
+        self._node_streams: Dict[str, object] = {}
+        # KeepConnected subscribers: name -> queue of VolumeLocation
+        self._subscribers: Dict[int, queue.Queue] = {}
+        self._sub_seq = 0
+        self._sub_lock = threading.Lock()
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> None:
+        if self.port == 0:
+            raise ValueError("master port must be fixed (grpc = port+10000)")
+        handler = rpc.generic_handler(master_pb2, "Seaweed", self)
+        self._grpc_server = rpc.make_server(
+            f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
+        self._http_server = ThreadingHTTPServer(
+            (self.ip, self.port), _make_http_handler(self))
+        self._http_thread = threading.Thread(
+            target=self._http_server.serve_forever, name="master-http",
+            daemon=True)
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._save_sequence()
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.2)
+
+    def _sequence_path(self) -> Optional[str]:
+        return os.path.join(self.meta_dir, "sequence.json") \
+            if self.meta_dir else None
+
+    def _load_sequence(self) -> int:
+        p = self._sequence_path() if self.meta_dir else None
+        if p and os.path.exists(p):
+            with open(p) as f:
+                return json.load(f).get("next", 1)
+        return 1
+
+    def _save_sequence(self) -> None:
+        p = self._sequence_path()
+        if p:
+            os.makedirs(self.meta_dir, exist_ok=True)
+            tmp = p + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"next": self.topo.sequence.peek}, f)
+            os.replace(tmp, p)
+
+    # -- KeepConnected fan-out -----------------------------------------------
+
+    def _broadcast(self, loc: master_pb2.VolumeLocation) -> None:
+        with self._sub_lock:
+            for q in self._subscribers.values():
+                q.put(loc)
+
+    def _full_locations(self) -> List[master_pb2.VolumeLocation]:
+        locs = []
+        for node in self.topo.nodes():
+            vids = sorted(set(node.volumes) | set(node.ec_shards))
+            if vids:
+                locs.append(master_pb2.VolumeLocation(
+                    url=node.url, public_url=node.public_url,
+                    new_vids=vids))
+        return locs
+
+    # -- gRPC: Seaweed service ------------------------------------------------
+
+    def SendHeartbeat(self, request_iterator, context):
+        node_url = None
+        stream_id = object()  # identity of THIS connection
+        try:
+            for hb in request_iterator:
+                d = convert.heartbeat_from_pb(hb)
+                node_url = f"{d['ip']}:{d['port']}"
+                self._node_streams[node_url] = stream_id
+                prev = self.topo.find_node(node_url)
+                before = (set(prev.volumes) | set(prev.ec_shards)) \
+                    if prev else set()
+                node = self.topo.sync_heartbeat(
+                    d, dc=hb.data_center or "DefaultDataCenter",
+                    rack=hb.rack or "DefaultRack")
+                after = set(node.volumes) | set(node.ec_shards)
+                new, deleted = sorted(after - before), sorted(before - after)
+                if new or deleted:
+                    self._broadcast(master_pb2.VolumeLocation(
+                        url=node.url, public_url=node.public_url,
+                        new_vids=new, deleted_vids=deleted))
+                yield master_pb2.HeartbeatResponse(
+                    volume_size_limit=self.topo.volume_size_limit,
+                    leader=self.url)
+        finally:
+            # stream break == node death (reference master_grpc_server.go:22-50)
+            # — but only if the node hasn't already reconnected on a
+            # fresh stream (cleanup is tied to this connection)
+            if node_url is not None and not self._stopping and \
+                    self._node_streams.get(node_url) is stream_id:
+                self._node_streams.pop(node_url, None)
+                node = self.topo.find_node(node_url)
+                if node is not None:
+                    gone = sorted(set(node.volumes) | set(node.ec_shards))
+                    self.topo.unregister_node(node_url)
+                    if gone:
+                        self._broadcast(master_pb2.VolumeLocation(
+                            url=node_url, public_url=node.public_url,
+                            deleted_vids=gone))
+
+    def KeepConnected(self, request_iterator, context):
+        try:
+            next(request_iterator)  # client introduces itself
+        except StopIteration:
+            return
+        q: queue.Queue = queue.Queue()
+        with self._sub_lock:
+            self._sub_seq += 1
+            key = self._sub_seq
+            self._subscribers[key] = q
+        try:
+            yield master_pb2.VolumeLocation(leader=self.url)
+            for loc in self._full_locations():
+                yield loc
+            while context.is_active():
+                try:
+                    yield q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._sub_lock:
+                self._subscribers.pop(key, None)
+
+    def LookupVolume(self, request, context):
+        out = []
+        for vid_str in request.volume_ids:
+            vid_part = vid_str.split(",")[0]
+            try:
+                vid = int(vid_part)
+            except ValueError:
+                out.append(master_pb2.LookupVolumeResponse.VolumeIdLocation(
+                    volume_id=vid_str, error="unknown volume id"))
+                continue
+            locs = self.lookup_locations(vid, request.collection)
+            if locs:
+                out.append(master_pb2.LookupVolumeResponse.VolumeIdLocation(
+                    volume_id=vid_str,
+                    locations=[master_pb2.Location(url=u, public_url=p)
+                               for u, p in locs]))
+            else:
+                out.append(master_pb2.LookupVolumeResponse.VolumeIdLocation(
+                    volume_id=vid_str, error=f"volume {vid} not found"))
+        return master_pb2.LookupVolumeResponse(volume_id_locations=out)
+
+    def lookup_locations(self, vid: int, collection: str = "") -> List[tuple]:
+        """[(url, public_url)] over normal replicas, else EC shard holders."""
+        nodes = self.topo.lookup(vid, collection)
+        if nodes:
+            return [(n.url, n.public_url) for n in nodes]
+        by_url = self.topo.lookup_ec(vid)
+        urls = []
+        for u in by_url:
+            n = self.topo.find_node(u)
+            urls.append((u, n.public_url if n else u))
+        return urls
+
+    def Assign(self, request, context):
+        try:
+            result = self.assign(
+                count=max(1, request.count or 1),
+                replication=request.replication,
+                collection=request.collection,
+                ttl=request.ttl,
+                data_center=request.data_center,
+                writable_volume_count=request.writable_volume_count)
+        except (NoFreeSlots, RuntimeError) as e:
+            return master_pb2.AssignResponse(error=str(e))
+        fid, count, locs = result
+        return master_pb2.AssignResponse(
+            fid=fid, url=locs[0].url, public_url=locs[0].public_url,
+            count=count)
+
+    def assign(self, count: int = 1, replication: str = "",
+               collection: str = "", ttl: str = "", data_center: str = "",
+               writable_volume_count: int = 0):
+        rp = ReplicaPlacement.parse(replication or self.default_replication)
+        rb = rp.to_byte()
+        if not self.topo.has_writable(collection, rb, ttl):
+            with self._grow_lock:
+                if not self.topo.has_writable(collection, rb, ttl):
+                    self.grow_volumes(
+                        writable_volume_count or growth_count(rp.copy_count),
+                        replication or self.default_replication,
+                        collection, ttl, data_center)
+        picked = self.topo.pick_for_write(
+            count=count, collection=collection, replica_byte=rb, ttl=ttl)
+        if picked is None:
+            raise RuntimeError("no writable volumes")
+        return picked
+
+    def grow_volumes(self, target_count: int, replication: str,
+                     collection: str = "", ttl: str = "",
+                     data_center: str = "") -> List[int]:
+        """AutomaticGrowByType: allocate `target_count` new volumes on
+        placement-picked servers (reference volume_growth.go:70-240)."""
+        rp = ReplicaPlacement.parse(replication or self.default_replication)
+        grown = []
+        for _ in range(max(1, target_count)):
+            try:
+                nodes = self.growth.find_empty_slots(rp, data_center)
+            except NoFreeSlots:
+                if grown:
+                    break  # partial growth still unblocks the assign
+                raise
+            vid = self.topo.reserve_volume_ids(1)[0]
+            ok_nodes = []
+            for n in nodes:
+                try:
+                    volume_stub(n.url).AllocateVolume(
+                        volume_server_pb2.AllocateVolumeRequest(
+                            volume_id=vid, collection=collection,
+                            replication=str(rp), ttl=ttl))
+                    ok_nodes.append(n)
+                except grpc.RpcError:
+                    continue  # dead node: heartbeat loss will reap it
+            if len(ok_nodes) < rp.copy_count:
+                # under-replicated: leave any created replicas for
+                # volume.fix.replication; don't hand out write locations
+                if grown:
+                    break
+                raise RuntimeError(
+                    f"volume allocation failed: {len(ok_nodes)}/"
+                    f"{rp.copy_count} replicas created for vid {vid}")
+            from seaweedfs_tpu.topology.node import VolumeInfo
+            for n in ok_nodes:
+                info = VolumeInfo(id=vid, collection=collection,
+                                  replica_placement=rp.to_byte(), ttl=ttl)
+                n.volumes[vid] = info
+                self.topo.register_volume(info, n)
+            self._broadcast_new_vid(vid, ok_nodes)
+            grown.append(vid)
+        return grown
+
+    def _broadcast_new_vid(self, vid: int, nodes) -> None:
+        for n in nodes:
+            self._broadcast(master_pb2.VolumeLocation(
+                url=n.url, public_url=n.public_url, new_vids=[vid]))
+
+    def Statistics(self, request, context):
+        used = file_count = 0
+        for node in self.topo.nodes():
+            for v in node.volumes.values():
+                if request.collection and v.collection != request.collection:
+                    continue
+                used += v.size
+                file_count += v.file_count
+        total = sum(n.max_volumes for n in self.topo.nodes()) \
+            * self.topo.volume_size_limit
+        return master_pb2.StatisticsResponse(
+            total_size=total, used_size=used, file_count=file_count)
+
+    def CollectionList(self, request, context):
+        names: Set[str] = set()
+        if request.include_normal_volumes or not request.include_ec_volumes:
+            for (col, _, _), vl in self.topo.layouts.items():
+                if vl.volume_ids:
+                    names.add(col)
+        if request.include_ec_volumes:
+            names.update(self.topo.ec_collections.values())
+        names.discard("")
+        return master_pb2.CollectionListResponse(
+            collections=[master_pb2.Collection(name=n) for n in sorted(names)])
+
+    def CollectionDelete(self, request, context):
+        for node in self.topo.nodes():
+            try:
+                volume_stub(node.url).DeleteCollection(
+                    volume_server_pb2.DeleteCollectionRequest(
+                        collection=request.name))
+            except Exception:
+                pass  # node down: its heartbeat resync will converge
+        return master_pb2.CollectionDeleteResponse()
+
+    def VolumeList(self, request, context):
+        return master_pb2.VolumeListResponse(
+            topology_info=convert.topology_to_pb(self.topo.to_map()),
+            volume_size_limit_mb=self.topo.volume_size_limit >> 20)
+
+    def LookupEcVolume(self, request, context):
+        by_url = self.topo.lookup_ec(request.volume_id)
+        if not by_url:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"ec volume {request.volume_id} not found")
+        shard_locs: Dict[int, List[str]] = {}
+        for url, bits in by_url.items():
+            for sid in bits.shard_ids:
+                shard_locs.setdefault(sid, []).append(url)
+        return master_pb2.LookupEcVolumeResponse(
+            volume_id=request.volume_id,
+            shard_id_locations=[
+                master_pb2.LookupEcVolumeResponse.EcShardIdLocation(
+                    shard_id=sid,
+                    locations=[master_pb2.Location(
+                        url=u,
+                        public_url=getattr(self.topo.find_node(u),
+                                           "public_url", u))
+                        for u in urls])
+                for sid, urls in sorted(shard_locs.items())])
+
+    def VacuumVolume(self, request, context):
+        self.vacuum(request.garbage_threshold or self.garbage_threshold)
+        return master_pb2.VacuumVolumeResponse()
+
+    def GetMasterConfiguration(self, request, context):
+        return master_pb2.GetMasterConfigurationResponse()
+
+    def LeaseAdminToken(self, request, context):
+        try:
+            token, ts = self.admin_lock.lease(request.previous_token)
+        except PermissionError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+        return master_pb2.LeaseAdminTokenResponse(token=token, lock_ts_ns=ts)
+
+    def ReleaseAdminToken(self, request, context):
+        self.admin_lock.release(request.previous_token)
+        return master_pb2.ReleaseAdminTokenResponse()
+
+    # -- vacuum driver --------------------------------------------------------
+
+    def vacuum(self, garbage_threshold: Optional[float] = None) -> List[int]:
+        """Poll garbage ratios and compact over-threshold volumes on all
+        replicas (reference topology/topology_vacuum.go:17-201)."""
+        threshold = garbage_threshold or self.garbage_threshold
+        compacted = []
+        seen: Set[int] = set()
+        for node in self.topo.nodes():
+            for vid, info in list(node.volumes.items()):
+                if vid in seen or info.read_only:
+                    continue
+                seen.add(vid)
+                replicas = self.topo.lookup(vid, info.collection) or [node]
+                try:
+                    if self._vacuum_one(vid, replicas, threshold):
+                        compacted.append(vid)
+                except Exception:
+                    for r in replicas:
+                        try:
+                            volume_stub(r.url).VacuumVolumeCleanup(
+                                volume_server_pb2.VacuumVolumeCleanupRequest(
+                                    volume_id=vid))
+                        except Exception:
+                            pass
+        return compacted
+
+    def _vacuum_one(self, vid: int, replicas, threshold: float) -> bool:
+        stubs = [volume_stub(r.url) for r in replicas]
+        checks = [s.VacuumVolumeCheck(
+            volume_server_pb2.VacuumVolumeCheckRequest(volume_id=vid))
+            for s in stubs]
+        if not checks or min(c.garbage_ratio for c in checks) < threshold:
+            return False
+        for s in stubs:
+            s.VacuumVolumeCompact(volume_server_pb2.VacuumVolumeCompactRequest(
+                volume_id=vid))
+        for s in stubs:
+            s.VacuumVolumeCommit(volume_server_pb2.VacuumVolumeCommitRequest(
+                volume_id=vid))
+        return True
+
+    # -- HTTP view ------------------------------------------------------------
+
+    def http_assign(self, params: dict) -> dict:
+        try:
+            fid, count, locs = self.assign(
+                count=int(params.get("count", ["1"])[0]),
+                replication=params.get("replication", [""])[0],
+                collection=params.get("collection", [""])[0],
+                ttl=params.get("ttl", [""])[0],
+                data_center=params.get("dataCenter", [""])[0])
+        except (NoFreeSlots, RuntimeError) as e:
+            return {"error": str(e)}
+        return {"fid": fid, "url": locs[0].url,
+                "publicUrl": locs[0].public_url, "count": count}
+
+    def http_lookup(self, params: dict) -> dict:
+        raw = params.get("volumeId", params.get("fileId", [""]))[0]
+        try:
+            vid = int(raw.split(",")[0])
+        except ValueError:
+            return {"error": f"bad volume id {raw!r}"}
+        locs = self.lookup_locations(
+            vid, params.get("collection", [""])[0])
+        if not locs:
+            return {"volumeId": str(vid), "error": "volume not found"}
+        return {"volumeId": str(vid),
+                "locations": [{"url": u, "publicUrl": p} for u, p in locs]}
+
+    def http_grow(self, params: dict) -> dict:
+        try:
+            grown = self.grow_volumes(
+                int(params.get("count", ["1"])[0]),
+                params.get("replication", [self.default_replication])[0],
+                params.get("collection", [""])[0],
+                params.get("ttl", [""])[0],
+                params.get("dataCenter", [""])[0])
+        except NoFreeSlots as e:
+            return {"error": str(e)}
+        return {"count": len(grown), "volumeIds": grown}
+
+    def http_cluster_status(self) -> dict:
+        return {"IsLeader": True, "Leader": self.url, "Peers": []}
+
+
+def _make_http_handler(ms: MasterServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _json(self, payload: dict, code: int = 200) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            params = parse_qs(u.query)
+            if u.path == "/dir/assign":
+                self._json(ms.http_assign(params))
+            elif u.path == "/dir/lookup":
+                self._json(ms.http_lookup(params))
+            elif u.path == "/dir/status":
+                self._json({"Topology": ms.topo.to_map(),
+                            "Version": "seaweedfs-tpu"})
+            elif u.path == "/vol/grow":
+                self._json(ms.http_grow(params))
+            elif u.path == "/vol/vacuum":
+                t = params.get("garbageThreshold", [None])[0]
+                vids = ms.vacuum(float(t) if t else None)
+                self._json({"compacted": vids})
+            elif u.path == "/cluster/status":
+                self._json(ms.http_cluster_status())
+            else:
+                self._json({"error": f"unknown path {u.path}"}, code=404)
+
+        do_POST = do_GET
+
+    return Handler

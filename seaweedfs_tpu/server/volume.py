@@ -1,0 +1,836 @@
+"""Volume server: the dataplane node.
+
+HTTP serves the public blob path (GET/POST/DELETE /<vid>,<fid>); gRPC
+serves the admin plane (allocate, vacuum, copy, the EC lifecycle); a
+background thread streams heartbeats to the master leader.
+
+Reference: weed/server/volume_server.go, volume_server_handlers_*.go,
+volume_grpc_*.go, volume_grpc_client_to_master.go.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.ec import store_ec
+from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
+from seaweedfs_tpu.ec.encoder import shard_file_name
+from seaweedfs_tpu.ec.shard_bits import TOTAL_SHARDS
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.pb import (master_pb2, master_stub, volume_server_pb2,
+                              volume_stub)
+from seaweedfs_tpu.server import convert
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.needle import CookieMismatch, Needle, NeedleError
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.superblock import TTL
+
+COPY_CHUNK = 1 << 20
+EC_LOCATION_TTL = 60.0  # seconds a cached shard-location set stays fresh
+
+
+class VolumeServer:
+    def __init__(self, master_url: str, directories: List[str],
+                 ip: str = "127.0.0.1", port: int = 8080,
+                 public_url: str = "", data_center: str = "",
+                 rack: str = "", max_volume_counts: Optional[List[int]] = None,
+                 pulse_seconds: float = 5.0, ec_encoder: str = "auto"):
+        self.master_url = master_url
+        self.ip = ip
+        self.port = port
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.ec_encoder = ec_encoder
+        self.store = Store(directories, max_volume_counts, ip=ip, port=port,
+                           public_url=public_url)
+        self.volume_size_limit = 30 << 30
+        self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
+        self._ec_locations: Dict[int, Tuple[float, Dict[int, List[str]]]] = {}
+        self._grpc_server = None
+        self._http_server = None
+        self._http_thread = None
+        self._hb_thread = None
+        self._hb_call = None
+        self._hb_wake = threading.Event()
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> None:
+        handler = rpc.generic_handler(
+            volume_server_pb2, "VolumeServer", self)
+        self._grpc_server = rpc.make_server(
+            f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
+        self._http_server = ThreadingHTTPServer(
+            (self.ip, self.port), _make_http_handler(self))
+        self._http_thread = threading.Thread(
+            target=self._http_server.serve_forever,
+            name=f"volume-http-{self.port}", daemon=True)
+        self._http_thread.start()
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name=f"heartbeat-{self.port}",
+            daemon=True)
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._hb_wake.set()
+        if self._hb_call is not None:
+            self._hb_call.cancel()
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.2)
+        self.store.close()
+
+    # -- heartbeat ------------------------------------------------------------
+
+    def _heartbeat_gen(self):
+        while not self._stopping:
+            hb = self.store.collect_heartbeat()
+            yield convert.heartbeat_to_pb(hb, self.data_center, self.rack)
+            self._hb_wake.wait(timeout=self.pulse_seconds)
+            self._hb_wake.clear()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopping:
+            try:
+                stub = master_stub(self.master_url)
+                self._hb_call = stub.SendHeartbeat(self._heartbeat_gen())
+                for resp in self._hb_call:
+                    if resp.volume_size_limit:
+                        self.volume_size_limit = resp.volume_size_limit
+                    if self._stopping:
+                        return
+            except grpc.RpcError:
+                if self._stopping:
+                    return
+                time.sleep(min(self.pulse_seconds, 1.0))
+
+    def trigger_heartbeat(self) -> None:
+        """Push a delta heartbeat now instead of waiting out the pulse."""
+        self._hb_wake.set()
+
+    # -- gRPC: volume lifecycle ------------------------------------------------
+
+    def AllocateVolume(self, request, context):
+        self.store.add_volume(request.volume_id, request.collection,
+                              replica_placement=request.replication or "000",
+                              ttl=request.ttl)
+        self.trigger_heartbeat()
+        return volume_server_pb2.AllocateVolumeResponse()
+
+    def VolumeDelete(self, request, context):
+        self.store.delete_volume(request.volume_id)
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeDeleteResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        if not self.store.mark_volume_readonly(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeMarkReadonlyResponse()
+
+    def VolumeMount(self, request, context):
+        vid = request.volume_id
+        if self.store.find_volume(vid) is None:
+            found = False
+            for loc in self.store.locations:
+                for name in os.listdir(loc.directory):
+                    if not name.endswith(".dat"):
+                        continue
+                    stem = name[:-len(".dat")]
+                    col, _, tail = stem.rpartition("_")
+                    if tail == str(vid) or (not col and stem == str(vid)):
+                        loc.add_volume(vid, col)
+                        found = True
+                        break
+                if found:
+                    break
+            if not found:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no .dat for volume {vid} on any disk")
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeMountResponse()
+
+    def VolumeUnmount(self, request, context):
+        vid = request.volume_id
+        for loc in self.store.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                v.close()
+                loc.volumes.pop(vid, None)
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeUnmountResponse()
+
+    def DeleteCollection(self, request, context):
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                if v.collection == request.collection:
+                    loc.delete_volume(vid)
+            for vid, ecv in list(loc.ec_volumes.items()):
+                if ecv.collection == request.collection:
+                    ecv.destroy()
+                    loc.ec_volumes.pop(vid, None)
+        self.trigger_heartbeat()
+        return volume_server_pb2.DeleteCollectionResponse()
+
+    def ReadVolumeFileStatus(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        base = v.file_name()
+        return volume_server_pb2.ReadVolumeFileStatusResponse(
+            volume_id=v.id,
+            idx_file_size=os.path.getsize(base + ".idx"),
+            dat_file_size=os.path.getsize(base + ".dat"),
+            file_count=v.file_count,
+            compaction_revision=v.super_block.compaction_revision,
+            collection=v.collection)
+
+    # -- gRPC: vacuum ----------------------------------------------------------
+
+    def VacuumVolumeCheck(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        return volume_server_pb2.VacuumVolumeCheckResponse(
+            garbage_ratio=v.garbage_ratio())
+
+    def VacuumVolumeCompact(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        self.compact_states[v.id] = vacuum_mod.compact(
+            v, preallocate=request.preallocate)
+        return volume_server_pb2.VacuumVolumeCompactResponse()
+
+    def VacuumVolumeCommit(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        state = self.compact_states.pop(request.volume_id, None)
+        if v is None or state is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"volume {request.volume_id}: no pending compaction")
+        vacuum_mod.commit_compact(v, state)
+        return volume_server_pb2.VacuumVolumeCommitResponse(
+            is_read_only=v.read_only)
+
+    def VacuumVolumeCleanup(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        self.compact_states.pop(request.volume_id, None)
+        if v is not None:
+            for ext in (".cpd", ".cpx"):
+                p = v.file_name() + ext
+                if os.path.exists(p):
+                    os.remove(p)
+        return volume_server_pb2.VacuumVolumeCleanupResponse()
+
+    # -- gRPC: batch delete ----------------------------------------------------
+
+    def BatchDelete(self, request, context):
+        results = []
+        for fid in request.file_ids:
+            try:
+                f = parse_fid(fid)
+            except ValueError as e:
+                results.append(volume_server_pb2.DeleteResult(
+                    file_id=fid, status=400, error=str(e)))
+                continue
+            n = Needle(id=f.key, cookie=f.cookie)
+            try:
+                if not request.skip_cookie_check:
+                    got = self._read_needle(f.volume_id, n)
+                    if got.cookie != f.cookie:
+                        raise CookieMismatch(f"cookie mismatch on {fid}")
+                size = self._delete_needle(f.volume_id, n)
+                results.append(volume_server_pb2.DeleteResult(
+                    file_id=fid, status=202, size=size))
+            except CookieMismatch as e:
+                results.append(volume_server_pb2.DeleteResult(
+                    file_id=fid, status=403, error=str(e)))
+            except (NeedleError, EcShardNotFound) as e:
+                results.append(volume_server_pb2.DeleteResult(
+                    file_id=fid, status=404, error=str(e)))
+        return volume_server_pb2.BatchDeleteResponse(results=results)
+
+    # -- gRPC: replica copy ----------------------------------------------------
+
+    def CopyFile(self, request, context):
+        path = self._file_path_for_copy(request)
+        if path is None or not os.path.exists(path):
+            if request.ignore_source_file_not_found:
+                return
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no file for vid={request.volume_id} "
+                          f"ext={request.ext}")
+        stop = request.stop_offset or os.path.getsize(path)
+        with open(path, "rb") as f:
+            sent = 0
+            while sent < stop:
+                chunk = f.read(min(COPY_CHUNK, stop - sent))
+                if not chunk:
+                    break
+                sent += len(chunk)
+                yield volume_server_pb2.CopyFileResponse(file_content=chunk)
+
+    def _file_path_for_copy(self, request) -> Optional[str]:
+        vid, ext = request.volume_id, request.ext
+        if request.is_ec_volume:
+            base = store_ec._find_ec_base(self.store, vid,
+                                          request.collection or None)
+            return base + ext if base else None
+        v = self.store.find_volume(vid)
+        return v.file_name() + ext if v else None
+
+    def VolumeCopy(self, request, context):
+        """Pull a whole volume (.dat + .idx) from source_data_node and
+        mount it (reference server/volume_grpc_copy.go)."""
+        vid = request.volume_id
+        if self.store.find_volume(vid) is not None:
+            context.abort(grpc.StatusCode.ALREADY_EXISTS,
+                          f"volume {vid} already exists")
+        src = volume_stub(request.source_data_node)
+        status = src.ReadVolumeFileStatus(
+            volume_server_pb2.ReadVolumeFileStatusRequest(volume_id=vid))
+        loc = next((l for l in self.store.locations if l.has_free_slot()),
+                   None)
+        if loc is None:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slot")
+        base = store_ec._base_name(loc.directory, status.collection, vid)
+        try:
+            for ext in (".idx", ".dat"):
+                self._pull_file(src, vid, ext, base + ext,
+                                collection=status.collection)
+        except grpc.RpcError:
+            for ext in (".idx", ".dat"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+            raise
+        loc.add_volume(vid, status.collection)
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeCopyResponse(
+            last_append_at_ns=time.time_ns())
+
+    def _pull_file(self, src_stub, vid: int, ext: str, dest_path: str,
+                   collection: str = "", is_ec: bool = False,
+                   ignore_missing: bool = False) -> None:
+        tmp = dest_path + ".copying"
+        with open(tmp, "wb") as f:
+            for resp in src_stub.CopyFile(volume_server_pb2.CopyFileRequest(
+                    volume_id=vid, ext=ext, collection=collection,
+                    is_ec_volume=is_ec,
+                    ignore_source_file_not_found=ignore_missing)):
+                f.write(resp.file_content)
+        os.replace(tmp, dest_path)
+
+    # -- gRPC: erasure coding --------------------------------------------------
+
+    def VolumeEcShardsGenerate(self, request, context):
+        try:
+            store_ec.generate_ec_shards(
+                self.store, request.volume_id,
+                backend=request.encoder or self.ec_encoder)
+        except NeedleError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return volume_server_pb2.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsRebuild(self, request, context):
+        try:
+            rebuilt = store_ec.rebuild_ec_shards(
+                self.store, request.volume_id,
+                collection=request.collection or None,
+                backend=request.encoder or self.ec_encoder)
+        except EcShardNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return volume_server_pb2.VolumeEcShardsRebuildResponse(
+            rebuilt_shard_ids=rebuilt)
+
+    def VolumeEcShardsCopy(self, request, context):
+        vid = request.volume_id
+        src = volume_stub(request.source_data_node)
+        loc = next((l for l in self.store.locations if l.has_free_slot()),
+                   self.store.locations[0])
+        base = store_ec._base_name(loc.directory, request.collection, vid)
+        for sid in request.shard_ids:
+            self._pull_file(src, vid, f".ec{sid:02d}",
+                            shard_file_name(base, sid),
+                            collection=request.collection, is_ec=True)
+        if request.copy_ecx_file:
+            self._pull_file(src, vid, ".ecx", base + ".ecx",
+                            collection=request.collection, is_ec=True)
+        if request.copy_ecj_file:
+            self._pull_file(src, vid, ".ecj", base + ".ecj",
+                            collection=request.collection, is_ec=True,
+                            ignore_missing=True)
+        return volume_server_pb2.VolumeEcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        store_ec.delete_ec_shards(self.store, request.volume_id,
+                                  collection=request.collection or None,
+                                  shard_ids=list(request.shard_ids))
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeEcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        try:
+            store_ec.mount_ec_shards(self.store, request.volume_id,
+                                     request.collection,
+                                     list(request.shard_ids))
+        except EcShardNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeEcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        store_ec.unmount_ec_shards(self.store, request.volume_id,
+                                   list(request.shard_ids))
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeEcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        try:
+            data = store_ec.read_ec_shard(
+                self.store, request.volume_id, request.shard_id,
+                request.offset, request.size)
+        except EcShardNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        for i in range(0, len(data), COPY_CHUNK):
+            yield volume_server_pb2.VolumeEcShardReadResponse(
+                data=data[i:i + COPY_CHUNK])
+
+    def VolumeEcBlobDelete(self, request, context):
+        try:
+            store_ec.delete_ec_needle(
+                self.store, request.volume_id,
+                Needle(id=request.file_key))
+        except EcShardNotFound as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return volume_server_pb2.VolumeEcBlobDeleteResponse()
+
+    def VolumeEcShardsToVolume(self, request, context):
+        try:
+            store_ec.ec_shards_to_volume(self.store, request.volume_id,
+                                         request.collection,
+                                         backend=self.ec_encoder)
+        except EcShardNotFound as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeEcShardsToVolumeResponse()
+
+    # -- gRPC: status ----------------------------------------------------------
+
+    def VolumeServerStatus(self, request, context):
+        disks = []
+        for loc in self.store.locations:
+            st = os.statvfs(loc.directory)
+            disks.append(volume_server_pb2.DiskStatus(
+                dir=loc.directory, all=st.f_blocks * st.f_frsize,
+                free=st.f_bavail * st.f_frsize,
+                used=(st.f_blocks - st.f_bfree) * st.f_frsize))
+        return volume_server_pb2.VolumeServerStatusResponse(
+            disk_statuses=disks)
+
+    def VolumeServerLeave(self, request, context):
+        """Graceful drain: stop heartbeats so the master forgets us."""
+        self._stopping = True
+        self._hb_wake.set()
+        if self._hb_call is not None:
+            self._hb_call.cancel()
+        return volume_server_pb2.VolumeServerLeaveResponse()
+
+    # -- needle data ops (shared by HTTP and gRPC paths) -----------------------
+
+    def _read_needle(self, vid: int, n: Needle) -> Needle:
+        if self.store.has_volume(vid):
+            return self.store.read_needle(vid, n)
+        if self.store.find_ec_volume(vid) is not None:
+            return store_ec.read_ec_needle(
+                self.store, vid, n,
+                remote_reader=self._make_remote_reader(vid))
+        raise NeedleError(f"volume {vid} not found")
+
+    def _delete_needle(self, vid: int, n: Needle) -> int:
+        if self.store.has_volume(vid):
+            return self.store.delete_needle(vid, n)
+        if self.store.find_ec_volume(vid) is not None:
+            store_ec.delete_ec_needle(self.store, vid, n)
+            return 0
+        raise NeedleError(f"volume {vid} not found")
+
+    def _make_remote_reader(self, vid: int):
+        def remote_reader(shard_id: int, offset: int, length: int):
+            for url in self._ec_shard_locations(vid).get(shard_id, []):
+                if url == self.url:
+                    continue
+                try:
+                    chunks = [r.data for r in volume_stub(url)
+                              .VolumeEcShardRead(
+                                  volume_server_pb2.VolumeEcShardReadRequest(
+                                      volume_id=vid, shard_id=shard_id,
+                                      offset=offset, size=length))]
+                    data = b"".join(chunks)
+                    if len(data) == length:
+                        return data
+                except grpc.RpcError:
+                    self._forget_ec_locations(vid)
+            return None
+        return remote_reader
+
+    def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
+        now = time.monotonic()
+        cached = self._ec_locations.get(vid)
+        if cached is not None and now - cached[0] < EC_LOCATION_TTL:
+            return cached[1]
+        locs: Dict[int, List[str]] = {}
+        try:
+            resp = master_stub(self.master_url).LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=vid))
+            for sl in resp.shard_id_locations:
+                locs[sl.shard_id] = [l.url for l in sl.locations]
+        except grpc.RpcError:
+            # master unreachable: serve stale cache if any, and don't
+            # poison the cache with an empty map for the next 60s
+            return cached[1] if cached is not None else {}
+        self._ec_locations[vid] = (now, locs)
+        return locs
+
+    def _forget_ec_locations(self, vid: int) -> None:
+        self._ec_locations.pop(vid, None)
+
+    # -- replication -----------------------------------------------------------
+
+    def _other_replicas(self, vid: int) -> List[str]:
+        try:
+            resp = master_stub(self.master_url).LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_ids=[str(vid)]))
+        except grpc.RpcError:
+            return []
+        urls = []
+        for vl in resp.volume_id_locations:
+            for loc in vl.locations:
+                if loc.url != self.url:
+                    urls.append(loc.url)
+        return urls
+
+    def replicated_write(self, vid: int, n: Needle,
+                         fsync: bool = False) -> int:
+        """Write locally then fan out the serialized needle to every
+        other replica (reference topology/store_replicate.go:21-94)."""
+        v = self.store.find_volume(vid)
+        if v is not None and v.read_only:
+            raise NeedleError(f"volume {vid} is read only")
+        _, size = self.store.write_needle(vid, n, fsync=fsync)
+        blob = n.to_bytes()
+        for url in self._other_replicas(vid):
+            req = urllib.request.Request(
+                f"http://{url}/admin/replicate?volume={vid}",
+                data=blob, method="POST",
+                headers={"Content-Type": "application/octet-stream"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                if resp.status >= 300:
+                    raise NeedleError(
+                        f"replicate to {url} failed: {resp.status}")
+        return size
+
+    def replicated_delete(self, vid: int, n: Needle) -> int:
+        size = self._delete_needle(vid, n)
+        for url in self._other_replicas(vid):
+            req = urllib.request.Request(
+                f"http://{url}/admin/replicate_delete"
+                f"?volume={vid}&key={n.id:x}&cookie={n.cookie:08x}",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30):
+                pass
+        return size
+
+
+# -- HTTP layer ---------------------------------------------------------------
+
+
+def parse_multipart(content_type: str, body: bytes):
+    """Minimal multipart/form-data parser: returns (filename, mime, data)
+    of the first file part (reference needle_parse_upload.go)."""
+    boundary = None
+    for piece in content_type.split(";"):
+        piece = piece.strip()
+        if piece.startswith("boundary="):
+            boundary = piece[len("boundary="):].strip('"')
+    if not boundary:
+        raise ValueError("multipart without boundary")
+    delim = b"--" + boundary.encode()
+    fallback = None
+    segments = body.split(delim)
+    for part in segments[1:]:
+        if part.startswith(b"--"):
+            break  # closing delimiter
+        # strip ONLY the framing CRLFs (after the delimiter line and
+        # before the next one) — trailing newlines inside the file
+        # content must survive
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        if part.endswith(b"\r\n"):
+            part = part[:-2]
+        header_blob, _, data = part.partition(b"\r\n\r\n")
+        headers = {}
+        for line in header_blob.split(b"\r\n"):
+            k, _, v = line.decode("utf-8", "replace").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        disp = headers.get("content-disposition", "")
+        filename = ""
+        for item in disp.split(";"):
+            item = item.strip()
+            if item.startswith("filename="):
+                filename = item[len("filename="):].strip('"')
+        mime = headers.get("content-type", "")
+        if filename:
+            return filename, mime, data
+        if fallback is None:
+            fallback = ("", mime, data)
+    if fallback is None:
+        raise ValueError("empty multipart body")
+    return fallback
+
+
+def _make_http_handler(vs: VolumeServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        # -- plumbing ---------------------------------------------------------
+
+        def _reply(self, code: int, body: bytes = b"",
+                   headers: Optional[dict] = None) -> None:
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _json(self, payload: dict, code: int = 200) -> None:
+            self._reply(code, json.dumps(payload).encode(),
+                        {"Content-Type": "application/json"})
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(length) if length else b""
+
+        def _parse_path(self):
+            """/<vid>,<key_hex><cookie_hex> with optional leading dirs."""
+            u = urlparse(self.path)
+            fid = u.path.lstrip("/")
+            return parse_fid(fid), parse_qs(u.query)
+
+        # -- read -------------------------------------------------------------
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path == "/status":
+                self._json(self.server_status())
+                return
+            try:
+                f, params = self._parse_path()
+            except ValueError as e:
+                self._json({"error": str(e)}, code=404)
+                return
+            n = Needle(id=f.key, cookie=f.cookie)
+            if not vs.store.has_volume(f.volume_id) and \
+                    vs.store.find_ec_volume(f.volume_id) is None:
+                self._redirect_to_replica(f)
+                return
+            try:
+                got = vs._read_needle(f.volume_id, n)
+            except CookieMismatch:
+                self._reply(404)
+                return
+            except (NeedleError, EcShardNotFound) as e:
+                self._json({"error": str(e)}, code=404)
+                return
+            self._send_needle(got)
+
+        do_HEAD = do_GET
+
+        def server_status(self) -> dict:
+            return {
+                "Version": "seaweedfs-tpu",
+                "Volumes": [Store.volume_info(v)
+                            for loc in vs.store.locations
+                            for v in loc.volumes.values()],
+            }
+
+        def _redirect_to_replica(self, f) -> None:
+            try:
+                resp = master_stub(vs.master_url).LookupVolume(
+                    master_pb2.LookupVolumeRequest(
+                        volume_ids=[str(f.volume_id)]))
+            except grpc.RpcError:
+                self._json({"error": "master unreachable"}, code=500)
+                return
+            for vl in resp.volume_id_locations:
+                for loc in vl.locations:
+                    if loc.url != vs.url:
+                        self._reply(302, headers={
+                            "Location": f"http://{loc.public_url or loc.url}"
+                                        f"/{f}"})
+                        return
+            self._json({"error": f"volume {f.volume_id} not found"},
+                       code=404)
+
+        def _send_needle(self, got: Needle) -> None:
+            etag = f'"{got.etag}"'
+            if self.headers.get("If-None-Match") == etag:
+                self._reply(304)
+                return
+            data = got.data
+            headers = {"ETag": etag, "Accept-Ranges": "bytes"}
+            if got.name:
+                headers["Content-Disposition"] = \
+                    f'inline; filename="{got.name.decode("utf-8", "replace")}"'
+            if got.mime:
+                headers["Content-Type"] = got.mime.decode("utf-8", "replace")
+            if got.is_compressed:
+                if "gzip" in (self.headers.get("Accept-Encoding") or ""):
+                    headers["Content-Encoding"] = "gzip"
+                else:
+                    data = gzip.decompress(data)
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes=") and not got.is_compressed:
+                try:
+                    start_s, _, end_s = rng[len("bytes="):].partition("-")
+                    if not start_s:  # suffix range: last N bytes
+                        start = max(0, len(data) - int(end_s))
+                        end = len(data) - 1
+                    else:
+                        start = int(start_s)
+                        end = int(end_s) if end_s else len(data) - 1
+                    end = min(end, len(data) - 1)
+                    if start > end or start < 0:
+                        raise ValueError
+                except ValueError:
+                    self._reply(416)
+                    return
+                headers["Content-Range"] = \
+                    f"bytes {start}-{end}/{len(data)}"
+                self._reply(206, data[start:end + 1], headers)
+                return
+            self._reply(200, data, headers)
+
+        # -- write ------------------------------------------------------------
+
+        def do_POST(self):
+            u = urlparse(self.path)
+            params = parse_qs(u.query)
+            if u.path == "/admin/replicate":
+                self._handle_replicate(params)
+                return
+            if u.path == "/admin/replicate_delete":
+                self._handle_replicate_delete(params)
+                return
+            try:
+                f, params = self._parse_path()
+            except ValueError as e:
+                self._json({"error": str(e)}, code=400)
+                return
+            body = self._body()
+            ctype = self.headers.get("Content-Type") or ""
+            filename, mime, data = "", ctype, body
+            if ctype.startswith("multipart/form-data"):
+                try:
+                    filename, mime, data = parse_multipart(ctype, body)
+                except ValueError as e:
+                    self._json({"error": str(e)}, code=400)
+                    return
+            ttl_s = params.get("ttl", [""])[0]
+            n = Needle(id=f.key, cookie=f.cookie, data=data,
+                       name=filename.encode() if filename else b"",
+                       mime=mime.encode() if mime and
+                       mime != "application/octet-stream" else b"",
+                       ttl=TTL.parse(ttl_s) if ttl_s else None)
+            try:
+                if params.get("type", [""])[0] == "replicate":
+                    _, size = vs.store.write_needle(f.volume_id, n)
+                else:
+                    size = vs.replicated_write(
+                        f.volume_id, n,
+                        fsync="fsync" in params)
+            except (NeedleError, urllib.error.URLError) as e:
+                self._json({"error": str(e)}, code=500)
+                return
+            self._json({"name": filename, "size": size,
+                        "eTag": n.etag}, code=201)
+
+        do_PUT = do_POST
+
+        def _handle_replicate(self, params: dict) -> None:
+            vid = int(params["volume"][0])
+            try:
+                n = Needle.from_bytes(self._body())
+                vs.store.write_needle(vid, n)
+            except NeedleError as e:
+                self._json({"error": str(e)}, code=500)
+                return
+            self._json({"size": n.size}, code=201)
+
+        def _handle_replicate_delete(self, params: dict) -> None:
+            vid = int(params["volume"][0])
+            n = Needle(id=int(params["key"][0], 16),
+                       cookie=int(params["cookie"][0], 16))
+            try:
+                vs._delete_needle(vid, n)
+            except (NeedleError, EcShardNotFound) as e:
+                self._json({"error": str(e)}, code=404)
+                return
+            self._json({}, code=202)
+
+        # -- delete -----------------------------------------------------------
+
+        def do_DELETE(self):
+            try:
+                f, params = self._parse_path()
+            except ValueError as e:
+                self._json({"error": str(e)}, code=400)
+                return
+            n = Needle(id=f.key, cookie=f.cookie)
+            try:
+                got = vs._read_needle(f.volume_id, n)
+                if got.cookie != f.cookie:
+                    self._json({"error": "cookie mismatch"}, code=403)
+                    return
+                if params.get("type", [""])[0] == "replicate":
+                    size = vs._delete_needle(f.volume_id, n)
+                else:
+                    size = vs.replicated_delete(f.volume_id, n)
+            except CookieMismatch:
+                self._json({"error": "cookie mismatch"}, code=403)
+                return
+            except (NeedleError, EcShardNotFound) as e:
+                self._json({"error": str(e)}, code=404)
+                return
+            self._json({"size": size}, code=202)
+
+    return Handler
