@@ -2,5 +2,11 @@
 (reference weed/operation)."""
 
 from seaweedfs_tpu.operation.file_id import FileId, format_fid, parse_fid
+from seaweedfs_tpu.operation.operations import (Assignment, assign,
+                                                delete_file, delete_files,
+                                                download, lookup, upload,
+                                                upload_data)
 
-__all__ = ["FileId", "parse_fid", "format_fid"]
+__all__ = ["FileId", "parse_fid", "format_fid", "Assignment", "assign",
+           "upload", "upload_data", "download", "lookup", "delete_file",
+           "delete_files"]

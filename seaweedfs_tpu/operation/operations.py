@@ -1,0 +1,140 @@
+"""Client ops against a cluster: assign, upload, lookup, delete.
+
+Reference: weed/operation/assign_file_id.go, upload_content.go,
+lookup.go, delete_content.go. HTTP data path + gRPC control, like the
+reference's clients.
+"""
+
+from __future__ import annotations
+
+import gzip as gzip_mod
+import json
+import secrets
+import urllib.parse
+import urllib.request
+from typing import Dict, List, NamedTuple
+
+from seaweedfs_tpu.pb import master_pb2, master_stub, volume_server_pb2, volume_stub
+
+
+class Assignment(NamedTuple):
+    fid: str
+    url: str
+    public_url: str
+    count: int
+
+
+def assign(master_url: str, count: int = 1, replication: str = "",
+           collection: str = "", ttl: str = "",
+           data_center: str = "") -> Assignment:
+    resp = master_stub(master_url).Assign(master_pb2.AssignRequest(
+        count=count, replication=replication, collection=collection,
+        ttl=ttl, data_center=data_center))
+    if resp.error:
+        raise RuntimeError(f"assign failed: {resp.error}")
+    return Assignment(resp.fid, resp.url, resp.public_url, resp.count)
+
+
+def upload_data(url_fid: str, data: bytes, filename: str = "",
+                mime: str = "", ttl: str = "", gzip: bool = False,
+                timeout: float = 60.0) -> dict:
+    """POST a blob to "host:port/fid". Optionally gzip-compresses."""
+    params = {}
+    if ttl:
+        params["ttl"] = ttl
+    qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+    headers = {}
+    if gzip:
+        data = gzip_mod.compress(data)
+        headers["Content-Encoding"] = "gzip"
+    boundary = "sw-" + secrets.token_hex(16)  # collision-proof framing
+    disp = f'form-data; name="file"'
+    if filename:
+        disp += f'; filename="{filename}"'
+    part_headers = f"Content-Disposition: {disp}\r\n"
+    if mime:
+        part_headers += f"Content-Type: {mime}\r\n"
+    body = (f"--{boundary}\r\n{part_headers}\r\n").encode() + data + \
+        f"\r\n--{boundary}--\r\n".encode()
+    headers["Content-Type"] = f"multipart/form-data; boundary={boundary}"
+    req = urllib.request.Request(
+        f"http://{url_fid}{qs}", data=body, method="POST", headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        out = json.load(r)
+    if out.get("error"):
+        raise RuntimeError(f"upload failed: {out['error']}")
+    return out
+
+
+def upload(master_url: str, data: bytes, filename: str = "", mime: str = "",
+           replication: str = "", collection: str = "", ttl: str = "",
+           data_center: str = "") -> str:
+    """Assign + upload; returns the fid."""
+    a = assign(master_url, replication=replication, collection=collection,
+               ttl=ttl, data_center=data_center)
+    upload_data(f"{a.url}/{a.fid}", data, filename=filename, mime=mime,
+                ttl=ttl)
+    return a.fid
+
+
+def lookup(master_url: str, vid: int, collection: str = "") -> List[str]:
+    resp = master_stub(master_url).LookupVolume(
+        master_pb2.LookupVolumeRequest(volume_ids=[str(vid)],
+                                       collection=collection))
+    for vl in resp.volume_id_locations:
+        if vl.error:
+            raise RuntimeError(vl.error)
+        return [l.url for l in vl.locations]
+    return []
+
+
+def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    urls = lookup(master_url, parse_fid(fid).volume_id)
+    if not urls:
+        raise RuntimeError(f"no locations for {fid}")
+    with urllib.request.urlopen(f"http://{urls[0]}/{fid}",
+                                timeout=timeout) as r:
+        return r.read()
+
+
+def delete_file(master_url: str, fid: str, timeout: float = 30.0) -> None:
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    urls = lookup(master_url, parse_fid(fid).volume_id)
+    if not urls:
+        return
+    req = urllib.request.Request(f"http://{urls[0]}/{fid}", method="DELETE")
+    with urllib.request.urlopen(req, timeout=timeout):
+        pass
+
+
+def delete_files(master_url: str, fids: List[str]) -> List[dict]:
+    """Batch delete, grouped by volume server
+    (reference operation/delete_content.go)."""
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    by_vid: Dict[int, List[str]] = {}
+    results = []
+    for fid in fids:
+        try:
+            by_vid.setdefault(parse_fid(fid).volume_id, []).append(fid)
+        except ValueError as e:
+            results.append({"fid": fid, "error": str(e)})
+    by_server: Dict[str, List[str]] = {}
+    for vid, group in by_vid.items():  # one lookup per distinct volume
+        try:
+            urls = lookup(master_url, vid)
+        except RuntimeError as e:
+            results.extend({"fid": f, "error": str(e)} for f in group)
+            continue
+        if not urls:
+            results.extend({"fid": f, "error": "no locations"}
+                           for f in group)
+            continue
+        by_server.setdefault(urls[0], []).extend(group)
+    for url, group in by_server.items():
+        resp = volume_stub(url).BatchDelete(
+            volume_server_pb2.BatchDeleteRequest(file_ids=group))
+        for r in resp.results:
+            results.append({"fid": r.file_id, "status": r.status,
+                            "error": r.error, "size": r.size})
+    return results

@@ -150,6 +150,13 @@ class VolumeServer:
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeMarkReadonlyResponse()
 
+    def VolumeMarkWritable(self, request, context):
+        if not self.store.mark_volume_writable(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeMarkWritableResponse()
+
     def VolumeMount(self, request, context):
         vid = request.volume_id
         if self.store.find_volume(vid) is None:

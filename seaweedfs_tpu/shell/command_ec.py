@@ -1,0 +1,320 @@
+"""EC lifecycle commands: ec.encode / ec.rebuild / ec.balance / ec.decode.
+
+Reference: weed/shell/command_ec_encode.go:55-298,
+command_ec_rebuild.go:97-244, command_ec_balance.go, command_ec_decode.go.
+The crash-safety ordering is the reference's: generate -> copy -> mount
+-> unmount/delete source -> delete original volume, so the source
+volume survives until all 14 shards are spread.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Optional
+
+from seaweedfs_tpu.ec.shard_bits import ShardBits, DATA_SHARDS, TOTAL_SHARDS
+from seaweedfs_tpu.pb import volume_server_pb2
+from seaweedfs_tpu.shell import command, ec_common
+from seaweedfs_tpu.shell.command_env import CommandEnv, EcNode
+
+
+@command("ec.encode", "erasure-code one volume (or all full ones) as "
+                      "RS(10,4) shards spread over the cluster")
+def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="ec.encode")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    p.add_argument("-fullPercent", type=float, default=95.0)
+    p.add_argument("-encoder", default="",
+                   help="tpu|jax|native|numpy|auto (kernel for the encode)")
+    args = p.parse_args(argv)
+    encoder = {"tpu": "jax"}.get(args.encoder, args.encoder)
+
+    vids = [args.volumeId] if args.volumeId else \
+        _collect_full_volumes(env, args.collection, args.fullPercent)
+    if not vids:
+        out.write("no volumes to encode\n")
+        return
+    env.acquire_lock()
+    try:
+        for vid in vids:
+            _do_ec_encode(env, vid, args.collection, encoder, out)
+    finally:
+        env.release_lock()
+
+
+def _collect_full_volumes(env: CommandEnv, collection: str,
+                          full_percent: float) -> List[int]:
+    limit = env.volume_size_limit()
+    vids = []
+    for vid, replicas in env.collect_volume_replicas().items():
+        info = replicas[0].info
+        if collection and info.collection != collection:
+            continue
+        if info.size >= limit * full_percent / 100.0:
+            vids.append(vid)
+    return sorted(vids)
+
+
+def _do_ec_encode(env: CommandEnv, vid: int, collection: str,
+                  encoder: str, out) -> None:
+    replicas = env.lookup(vid, collection)
+    if not replicas:
+        out.write(f"volume {vid}: no locations\n")
+        return
+    collection = collection or _volume_collection(env, vid)
+    # 1. freeze writes on every replica
+    for url in replicas:
+        env.volume_server(url).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+    # 2. generate all 14 shards on the first replica holder
+    source = replicas[0]
+    env.volume_server(source).VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=collection, encoder=encoder))
+    out.write(f"volume {vid}: generated 14 shards on {source}\n")
+    # 3. spread by free slots
+    nodes = env.collect_ec_nodes()
+    plan = ec_common.balanced_distribution(nodes)
+    _spread_ec_shards(env, vid, collection, source, plan, out)
+    # 4. the original volume is now redundant
+    for url in replicas:
+        env.volume_server(url).VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+    out.write(f"volume {vid}: ec.encode done "
+              f"({sum(len(s) for s in plan.values())} shards on "
+              f"{len(plan)} nodes)\n")
+
+
+def _volume_collection(env: CommandEnv, vid: int) -> str:
+    for v, replicas in env.collect_volume_replicas().items():
+        if v == vid:
+            return replicas[0].info.collection
+    return ""
+
+
+def _spread_ec_shards(env: CommandEnv, vid: int, collection: str,
+                      source: str, plan: Dict[str, List[int]], out) -> None:
+    """copy -> mount on each target, then unmount+delete the moved
+    shards from the source (reference command_ec_encode.go:160-246)."""
+    moved_away = []
+    for target, sids in plan.items():
+        if target != source:
+            env.volume_server(target).VolumeEcShardsCopy(
+                volume_server_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection=collection, shard_ids=sids,
+                    copy_ecx_file=True, copy_ecj_file=True,
+                    source_data_node=source))
+            moved_away.extend(sids)
+        env.volume_server(target).VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=sids))
+        out.write(f"volume {vid}: shards {sids} -> {target}\n")
+    if moved_away:
+        env.volume_server(source).VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=moved_away))
+        env.volume_server(source).VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection,
+                shard_ids=moved_away))
+
+
+@command("ec.rebuild", "regenerate missing EC shards on the roomiest node")
+def ec_rebuild(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="ec.rebuild")
+    p.add_argument("-collection", default="")
+    p.add_argument("-encoder", default="")
+    args = p.parse_args(argv)
+    encoder = {"tpu": "jax"}.get(args.encoder, args.encoder)
+    env.acquire_lock()
+    try:
+        nodes = env.collect_ec_nodes()
+        vids = sorted({vid for n in nodes for vid in n.shards})
+        for vid in vids:
+            missing = ec_common.missing_shards(nodes, vid)
+            if not missing:
+                continue
+            if TOTAL_SHARDS - len(missing) < DATA_SHARDS:
+                out.write(f"volume {vid}: only "
+                          f"{TOTAL_SHARDS - len(missing)} shards left, "
+                          f"cannot rebuild\n")
+                continue
+            _rebuild_one(env, nodes, vid, missing, encoder, out)
+    finally:
+        env.release_lock()
+
+
+def _rebuild_one(env: CommandEnv, nodes: List[EcNode], vid: int,
+                 missing: List[int], encoder: str, out) -> None:
+    rebuilder = ec_common.pick_rebuilder(nodes)
+    collection = _ec_collection(env, vid)
+    local = rebuilder.shards.get(vid, ShardBits(0))
+    # pull enough foreign shards (files only, no mount) to reach >=10
+    pulled = []
+    for n in nodes:
+        if n.url == rebuilder.url:
+            continue
+        for sid in n.shards.get(vid, ShardBits(0)).shard_ids:
+            if local.has(sid) or sid in pulled:
+                continue
+            if local.count + len(pulled) >= DATA_SHARDS:
+                break
+            env.volume_server(rebuilder.url).VolumeEcShardsCopy(
+                volume_server_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection=collection, shard_ids=[sid],
+                    copy_ecx_file=not local.count and not pulled,
+                    copy_ecj_file=not local.count and not pulled,
+                    source_data_node=n.url))
+            pulled.append(sid)
+    resp = env.volume_server(rebuilder.url).VolumeEcShardsRebuild(
+        volume_server_pb2.VolumeEcShardsRebuildRequest(
+            volume_id=vid, collection=collection, encoder=encoder))
+    env.volume_server(rebuilder.url).VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=collection, shard_ids=missing))
+    # drop the scaffolding: pulled copies, plus shards the local rebuild
+    # regenerated that other nodes still hold (would be duplicates)
+    to_delete = sorted(set(pulled) |
+                       (set(resp.rebuilt_shard_ids) - set(missing)))
+    if to_delete:
+        env.volume_server(rebuilder.url).VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection, shard_ids=to_delete))
+    out.write(f"volume {vid}: rebuilt shards {missing} on "
+              f"{rebuilder.url}\n")
+
+
+def _ec_collections(env: CommandEnv) -> Dict[int, str]:
+    """vid -> collection for every EC volume, from one topology RPC."""
+    topo = env.topology()
+    out: Dict[int, str] = {}
+    for _, _, dn in env.data_nodes(topo):
+        for e in dn.ec_shard_infos:
+            out.setdefault(e.id, e.collection)
+    return out
+
+
+def _ec_collection(env: CommandEnv, vid: int) -> str:
+    return _ec_collections(env).get(vid, "")
+
+
+@command("ec.balance", "dedupe and spread EC shards evenly over nodes")
+def ec_balance(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="ec.balance")
+    p.add_argument("-apply", action="store_true", default=False,
+                   help="execute the plan (default: print it only)")
+    args = p.parse_args(argv)
+    if not args.apply:
+        nodes = env.collect_ec_nodes()
+        for vid, sid, url in ec_common.plan_dedupe(nodes):
+            out.write(f"would drop duplicate shard {sid} of volume "
+                      f"{vid} from {url}\n")
+        for mv in ec_common.plan_balance(nodes):
+            out.write(f"would move shards {list(mv.shard_ids)} of "
+                      f"volume {mv.vid} {mv.src} -> {mv.dst}\n")
+        out.write("dry run; add -apply to execute\n")
+        return
+    env.acquire_lock()
+    try:
+        collections = _ec_collections(env)
+        nodes = env.collect_ec_nodes()
+        for vid, sid, url in ec_common.plan_dedupe(nodes):
+            env.volume_server(url).VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=[sid]))
+            env.volume_server(url).VolumeEcShardsDelete(
+                volume_server_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid,
+                    collection=collections.get(vid, ""),
+                    shard_ids=[sid]))
+            out.write(f"volume {vid}: dropped duplicate shard {sid} "
+                      f"from {url}\n")
+        nodes = env.collect_ec_nodes()
+        for mv in ec_common.plan_balance(nodes):
+            collection = collections.get(mv.vid, "")
+            env.volume_server(mv.dst).VolumeEcShardsCopy(
+                volume_server_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids), copy_ecx_file=True,
+                    copy_ecj_file=True, source_data_node=mv.src))
+            env.volume_server(mv.dst).VolumeEcShardsMount(
+                volume_server_pb2.VolumeEcShardsMountRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids)))
+            env.volume_server(mv.src).VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=mv.vid, shard_ids=list(mv.shard_ids)))
+            env.volume_server(mv.src).VolumeEcShardsDelete(
+                volume_server_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids)))
+            out.write(f"volume {mv.vid}: moved shards "
+                      f"{list(mv.shard_ids)} {mv.src} -> {mv.dst}\n")
+    finally:
+        env.release_lock()
+
+
+@command("ec.decode", "decode an EC volume back into a normal volume")
+def ec_decode(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="ec.decode")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    args = p.parse_args(argv)
+    env.acquire_lock()
+    try:
+        nodes = env.collect_ec_nodes()
+        vids = [args.volumeId] if args.volumeId else \
+            sorted({vid for n in nodes for vid in n.shards})
+        for vid in vids:
+            _decode_one(env, nodes, vid, out)
+    finally:
+        env.release_lock()
+
+
+def _decode_one(env: CommandEnv, nodes: List[EcNode], vid: int, out) -> None:
+    holders = [n for n in nodes if vid in n.shards]
+    if not holders:
+        out.write(f"volume {vid}: no ec shards\n")
+        return
+    collection = _ec_collection(env, vid)
+    target = max(holders, key=lambda n: n.shards[vid].count)
+    local = target.shards[vid]
+    # pull shards until the target can decode: either all 10 data
+    # shards, or >=10 of any kind (the decode regenerates missing data
+    # from parity locally). Data shards first, parity as backfill.
+    data_local = sum(1 for s in range(DATA_SHARDS) if local.has(s))
+    for want_data in (True, False):
+        for n in holders:
+            if n.url == target.url:
+                continue
+            for sid in n.shards[vid].shard_ids:
+                if local.has(sid) or (sid < DATA_SHARDS) != want_data:
+                    continue
+                if data_local >= DATA_SHARDS or \
+                        local.count >= DATA_SHARDS:
+                    break
+                env.volume_server(target.url).VolumeEcShardsCopy(
+                    volume_server_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid, collection=collection,
+                        shard_ids=[sid], source_data_node=n.url))
+                local = local.add(sid)
+                if sid < DATA_SHARDS:
+                    data_local += 1
+    # unmount everywhere, then decode on the target
+    for n in holders:
+        env.volume_server(n.url).VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid,
+                shard_ids=n.shards[vid].shard_ids))
+    env.volume_server(target.url).VolumeEcShardsToVolume(
+        volume_server_pb2.VolumeEcShardsToVolumeRequest(
+            volume_id=vid, collection=collection))
+    # drop all shard files cluster-wide
+    for n in holders:
+        env.volume_server(n.url).VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection,
+                shard_ids=list(range(TOTAL_SHARDS))))
+    out.write(f"volume {vid}: decoded back to a normal volume on "
+              f"{target.url}\n")

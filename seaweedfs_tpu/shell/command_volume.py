@@ -1,0 +1,236 @@
+"""Volume ops-plane commands: list, balance, fix.replication, vacuum,
+move, mount/unmount, mark, delete.
+
+Reference: weed/shell/command_volume_*.go. Balance/fix planning is
+pure over the TopologyInfo snapshot (testable on fabricated views).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+from seaweedfs_tpu.pb import master_pb2, volume_server_pb2
+from seaweedfs_tpu.shell import command
+from seaweedfs_tpu.shell.command_env import CommandEnv
+from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+
+
+class VolumeMove(NamedTuple):
+    vid: int
+    src: str
+    dst: str
+
+
+def plan_volume_balance(counts: Dict[str, List[int]],
+                        max_counts: Dict[str, int]) -> List[VolumeMove]:
+    """counts: url -> vids held. Move volumes from fullest to emptiest
+    (by used/max ratio) until within one volume of balance."""
+    urls = list(counts)
+    if len(urls) < 2:
+        return []
+    held = {u: list(v) for u, v in counts.items()}
+    moves: List[VolumeMove] = []
+
+    def ratio(u):
+        return len(held[u]) / max(1, max_counts.get(u, 8))
+
+    for _ in range(sum(len(v) for v in held.values())):
+        src = max(urls, key=ratio)
+        dst = min(urls, key=ratio)
+        if src == dst or len(held[src]) - len(held[dst]) <= 1:
+            break
+        movable = [v for v in held[src] if v not in held[dst]]
+        if not movable:
+            break
+        vid = movable[0]
+        held[src].remove(vid)
+        held[dst].append(vid)
+        moves.append(VolumeMove(vid, src, dst))
+    return moves
+
+
+def plan_fix_replication(replicas_by_vid: Dict[int, List[Tuple[str, int]]],
+                         all_urls: List[str]) -> List[VolumeMove]:
+    """replicas_by_vid: vid -> [(url, replica_placement_byte)].
+    Returns copies needed to restore the replica count."""
+    fixes = []
+    for vid, replicas in replicas_by_vid.items():
+        want = ReplicaPlacement.from_byte(replicas[0][1]).copy_count
+        have_urls = [u for u, _ in replicas]
+        missing = want - len(have_urls)
+        if missing <= 0:
+            continue
+        candidates = [u for u in all_urls if u not in have_urls]
+        for dst in candidates[:missing]:
+            fixes.append(VolumeMove(vid, have_urls[0], dst))
+    return fixes
+
+
+@command("volume.list", "show the topology tree")
+def volume_list(env: CommandEnv, argv: List[str], out) -> None:
+    topo = env.topology()
+    out.write(f"Topology volumes:{topo.volume_count} "
+              f"max:{topo.max_volume_count} "
+              f"free:{topo.free_volume_count}\n")
+    for dc in topo.data_center_infos:
+        out.write(f"  DataCenter {dc.id}\n")
+        for rack in dc.rack_infos:
+            out.write(f"    Rack {rack.id}\n")
+            for dn in rack.data_node_infos:
+                out.write(f"      DataNode {dn.id} "
+                          f"volumes:{dn.volume_count} "
+                          f"max:{dn.max_volume_count}\n")
+                for vi in dn.volume_infos:
+                    out.write(f"        volume id:{vi.id} "
+                              f"size:{vi.size} "
+                              f"collection:{vi.collection!r} "
+                              f"files:{vi.file_count} "
+                              f"deleted:{vi.delete_count} "
+                              f"ro:{vi.read_only}\n")
+                for e in dn.ec_shard_infos:
+                    from seaweedfs_tpu.ec.shard_bits import ShardBits
+                    out.write(f"        ec volume id:{e.id} "
+                              f"collection:{e.collection!r} "
+                              f"shards:{ShardBits(e.ec_index_bits).shard_ids}\n")
+
+
+@command("volume.balance", "move volumes so servers are evenly loaded")
+def volume_balance(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.balance")
+    p.add_argument("-collection", default="",
+                   help="restrict to one collection ('' = all)")
+    args = p.parse_args(argv)
+    env.acquire_lock()
+    try:
+        topo = env.topology()
+        counts: Dict[str, List[int]] = {}
+        max_counts: Dict[str, int] = {}
+        for _, _, dn in env.data_nodes(topo):
+            vids = [vi.id for vi in dn.volume_infos
+                    if not args.collection
+                    or vi.collection == args.collection]
+            counts[dn.id] = vids
+            max_counts[dn.id] = int(dn.max_volume_count)
+        for mv in plan_volume_balance(counts, max_counts):
+            _move_volume(env, mv, out)
+    finally:
+        env.release_lock()
+
+
+def _move_volume(env: CommandEnv, mv: VolumeMove, out) -> None:
+    """copy to dst (pull from src), then delete from src — the
+    reference's volume.move ordering (command_volume_move.go)."""
+    env.volume_server(mv.dst).VolumeCopy(
+        volume_server_pb2.VolumeCopyRequest(
+            volume_id=mv.vid, source_data_node=mv.src))
+    env.volume_server(mv.src).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=mv.vid))
+    out.write(f"volume {mv.vid}: moved {mv.src} -> {mv.dst}\n")
+
+
+@command("volume.move", "move one volume between servers")
+def volume_move(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.move")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-source", required=True)
+    p.add_argument("-target", required=True)
+    args = p.parse_args(argv)
+    env.acquire_lock()
+    try:
+        _move_volume(env, VolumeMove(args.volumeId, args.source,
+                                     args.target), out)
+    finally:
+        env.release_lock()
+
+
+@command("volume.fix.replication", "re-create missing replicas")
+def volume_fix_replication(env: CommandEnv, argv: List[str], out) -> None:
+    env.acquire_lock()
+    try:
+        topo = env.topology()
+        replicas: Dict[int, List[Tuple[str, int]]] = {}
+        urls = []
+        for _, _, dn in env.data_nodes(topo):
+            urls.append(dn.id)
+            for vi in dn.volume_infos:
+                replicas.setdefault(vi.id, []).append(
+                    (dn.id, vi.replica_placement))
+        fixes = plan_fix_replication(replicas, urls)
+        for mv in fixes:
+            env.volume_server(mv.dst).VolumeCopy(
+                volume_server_pb2.VolumeCopyRequest(
+                    volume_id=mv.vid, source_data_node=mv.src))
+            out.write(f"volume {mv.vid}: replicated {mv.src} -> "
+                      f"{mv.dst}\n")
+        if not fixes:
+            out.write("all volumes sufficiently replicated\n")
+    finally:
+        env.release_lock()
+
+
+@command("volume.vacuum", "compact volumes above the garbage threshold")
+def volume_vacuum(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.vacuum")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    args = p.parse_args(argv)
+    env.master.VacuumVolume(master_pb2.VacuumVolumeRequest(
+        garbage_threshold=args.garbageThreshold))
+    out.write("vacuum triggered\n")
+
+
+@command("volume.mark", "mark a volume readonly/writable")
+def volume_mark(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.mark")
+    p.add_argument("-volumeId", type=int, required=True)
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("-readonly", action="store_true")
+    g.add_argument("-writable", action="store_true")
+    args = p.parse_args(argv)
+    for url in env.lookup(args.volumeId):
+        if args.readonly:
+            env.volume_server(url).VolumeMarkReadonly(
+                volume_server_pb2.VolumeMarkReadonlyRequest(
+                    volume_id=args.volumeId))
+        else:
+            env.volume_server(url).VolumeMarkWritable(
+                volume_server_pb2.VolumeMarkWritableRequest(
+                    volume_id=args.volumeId))
+        state = "readonly" if args.readonly else "writable"
+        out.write(f"volume {args.volumeId}: {state} on {url}\n")
+
+
+@command("volume.delete", "delete a volume from a server")
+def volume_delete(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.delete")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", default="",
+                   help="server url; all holders when empty")
+    args = p.parse_args(argv)
+    urls = [args.node] if args.node else env.lookup(args.volumeId)
+    for url in urls:
+        env.volume_server(url).VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=args.volumeId))
+        out.write(f"volume {args.volumeId}: deleted from {url}\n")
+
+
+@command("volume.mount", "mount a volume from existing files")
+def volume_mount(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.mount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    args = p.parse_args(argv)
+    env.volume_server(args.node).VolumeMount(
+        volume_server_pb2.VolumeMountRequest(volume_id=args.volumeId))
+    out.write(f"volume {args.volumeId}: mounted on {args.node}\n")
+
+
+@command("volume.unmount", "unmount a volume (files stay)")
+def volume_unmount(env: CommandEnv, argv: List[str], out) -> None:
+    p = argparse.ArgumentParser(prog="volume.unmount")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-node", required=True)
+    args = p.parse_args(argv)
+    env.volume_server(args.node).VolumeUnmount(
+        volume_server_pb2.VolumeUnmountRequest(volume_id=args.volumeId))
+    out.write(f"volume {args.volumeId}: unmounted on {args.node}\n")

@@ -1,0 +1,109 @@
+"""Pure EC placement planning over topology snapshots.
+
+Separated from the RPC-applying commands so the plans are unit-testable
+against fabricated cluster views, like the reference's
+shell/command_ec_test.go pattern.
+
+Reference: weed/shell/command_ec_common.go, command_ec_encode.go:248-264.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Tuple
+
+from seaweedfs_tpu.ec.shard_bits import ShardBits, TOTAL_SHARDS
+from seaweedfs_tpu.shell.command_env import EcNode
+
+
+class ShardMove(NamedTuple):
+    vid: int
+    shard_ids: Tuple[int, ...]
+    src: str  # node url holding the shard(s)
+    dst: str
+
+
+def balanced_distribution(nodes: List[EcNode], total: int = TOTAL_SHARDS
+                          ) -> Dict[str, List[int]]:
+    """Assign `total` shard ids over nodes, each next shard to the node
+    with the most remaining free slots (reference
+    balancedEcDistribution, command_ec_encode.go:248-264)."""
+    if not nodes:
+        return {}
+    remaining = {n.url: max(n.free_slots, 0) for n in nodes}
+    out: Dict[str, List[int]] = {n.url: [] for n in nodes}
+    for sid in range(total):
+        url = max(remaining, key=lambda u: (remaining[u], -len(out[u])))
+        out[url].append(sid)
+        remaining[url] -= 1
+    return {u: sids for u, sids in out.items() if sids}
+
+
+def plan_dedupe(nodes: List[EcNode]) -> List[Tuple[int, int, str]]:
+    """(vid, shard_id, url_to_delete_from) for every duplicated shard;
+    the copy on the node with the fewest total shards survives."""
+    holders: Dict[Tuple[int, int], List[EcNode]] = {}
+    for n in nodes:
+        for vid, bits in n.shards.items():
+            for sid in bits.shard_ids:
+                holders.setdefault((vid, sid), []).append(n)
+    deletes = []
+    for (vid, sid), ns in holders.items():
+        if len(ns) <= 1:
+            continue
+        ns_sorted = sorted(ns, key=lambda n: n.shard_count())
+        for n in ns_sorted[1:]:
+            deletes.append((vid, sid, n.url))
+    return deletes
+
+
+def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
+    """Even out total shard counts across nodes (reference
+    ec.balance's doBalanceEcShardsAcrossRacks simplified to node
+    granularity; rack awareness comes from the move target choice)."""
+    if len(nodes) < 2:
+        return []
+    counts = {n.url: n.shard_count() for n in nodes}
+    by_url = {n.url: dict(n.shards) for n in nodes}
+    total = sum(counts.values())
+    avg = total / len(nodes)
+    moves: List[ShardMove] = []
+    # move shards one at a time from the fullest node to the emptiest
+    for _ in range(total):
+        src = max(counts, key=lambda u: counts[u])
+        dst = min(counts, key=lambda u: counts[u])
+        if counts[src] - 1 < avg - 0.5 or counts[dst] + 1 > avg + 0.5 \
+                or src == dst:
+            break
+        moved = False
+        for vid, bits in sorted(by_url[src].items()):
+            dst_bits = by_url[dst].get(vid, ShardBits(0))
+            for sid in bits.shard_ids:
+                if dst_bits.has(sid):
+                    continue
+                moves.append(ShardMove(vid, (sid,), src, dst))
+                by_url[src][vid] = bits.remove(sid)
+                if not by_url[src][vid].count:
+                    del by_url[src][vid]
+                by_url[dst][vid] = dst_bits.add(sid)
+                counts[src] -= 1
+                counts[dst] += 1
+                moved = True
+                break
+            if moved:
+                break
+        if not moved:
+            break
+    return moves
+
+
+def missing_shards(nodes: List[EcNode], vid: int) -> List[int]:
+    have = ShardBits(0)
+    for n in nodes:
+        have = have.plus(n.shards.get(vid, ShardBits(0)))
+    return [sid for sid in range(TOTAL_SHARDS) if not have.has(sid)]
+
+
+def pick_rebuilder(nodes: List[EcNode]) -> EcNode:
+    """The roomiest node does the rebuild (reference
+    command_ec_rebuild.go:97-150)."""
+    return max(nodes, key=lambda n: n.free_slots)

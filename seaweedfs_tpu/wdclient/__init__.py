@@ -1,0 +1,6 @@
+"""Cluster client library (reference weed/wdclient)."""
+
+from seaweedfs_tpu.wdclient.masterclient import MasterClient
+from seaweedfs_tpu.wdclient.vid_map import VidMap
+
+__all__ = ["MasterClient", "VidMap"]

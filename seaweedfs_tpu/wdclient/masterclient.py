@@ -1,0 +1,113 @@
+"""MasterClient: live vid->location cache + leader tracking.
+
+Holds a background KeepConnected stream to the master; deltas keep the
+VidMap fresh so data-path clients never block on /dir/lookup.
+
+Reference: weed/wdclient/masterclient.go:16-160.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import grpc
+
+from seaweedfs_tpu.pb import master_pb2, master_stub
+from seaweedfs_tpu.wdclient.vid_map import Location, VidMap
+
+
+class MasterClient:
+    def __init__(self, masters: List[str], client_name: str = "client"):
+        if not masters:
+            raise ValueError("need at least one master address")
+        self.masters = masters
+        self.client_name = client_name
+        self.current_master = masters[0]
+        self.vid_map = VidMap()
+        self._stop = threading.Event()
+        self._ready = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stream = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "MasterClient":
+        self._thread = threading.Thread(
+            target=self._keep_connected_loop,
+            name=f"masterclient-{self.client_name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def wait_until_connected(self, timeout: float = 10.0) -> None:
+        if not self._ready.wait(timeout):
+            raise TimeoutError("master KeepConnected never came up")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._stream is not None:
+            self._stream.cancel()
+
+    # -- stream --------------------------------------------------------------
+
+    def _keep_connected_loop(self) -> None:
+        while not self._stop.is_set():
+            for target in [self.current_master] + \
+                    [m for m in self.masters if m != self.current_master]:
+                if self._stop.is_set():
+                    return
+                try:
+                    self._follow(target)
+                except grpc.RpcError:
+                    continue
+            time.sleep(0.5)
+
+    def _follow(self, target: str) -> None:
+        stub = master_stub(target)
+        self._stream = stub.KeepConnected(iter(
+            [master_pb2.KeepConnectedRequest(name=self.client_name)]))
+        for loc in self._stream:
+            if self._stop.is_set():
+                return
+            self.current_master = target
+            if loc.leader and loc.leader != target:
+                # not the leader: reconnect there next
+                self.current_master = loc.leader
+                self._stream.cancel()
+                return
+            self._apply(loc)
+            self._ready.set()
+
+    def _apply(self, loc: master_pb2.VolumeLocation) -> None:
+        if loc.url:
+            l = Location(loc.url, loc.public_url or loc.url)
+            for vid in loc.new_vids:
+                self.vid_map.add_location(vid, l)
+            for vid in loc.deleted_vids:
+                self.vid_map.delete_location(vid, loc.url)
+
+    # -- lookups -------------------------------------------------------------
+
+    def lookup(self, vid: int) -> List[Location]:
+        locs = self.vid_map.lookup(vid)
+        if locs:
+            return locs
+        # cache miss: ask the master directly and backfill
+        try:
+            resp = master_stub(self.current_master).LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_ids=[str(vid)]))
+        except grpc.RpcError:
+            return []
+        for vl in resp.volume_id_locations:
+            for l in vl.locations:
+                self.vid_map.add_location(vid, Location(l.url, l.public_url))
+        return self.vid_map.lookup(vid)
+
+    def lookup_file_id(self, fid: str) -> str:
+        from seaweedfs_tpu.operation.file_id import parse_fid
+        vid = parse_fid(fid).volume_id
+        locs = self.lookup(vid)
+        if not locs:
+            raise KeyError(f"volume {vid} has no known locations")
+        return f"{locs[0].url}/{fid}"

@@ -1,0 +1,233 @@
+"""Shell command tests.
+
+Pure placement planning is tested on fabricated views (the reference's
+command_ec_test.go pattern); the EC lifecycle commands run against a
+real in-process cluster.
+"""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.ec.shard_bits import ShardBits, TOTAL_SHARDS
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.shell import Shell, ec_common
+from seaweedfs_tpu.shell.command_env import EcNode
+from seaweedfs_tpu.shell.command_volume import (plan_fix_replication,
+                                                plan_volume_balance)
+from tests.cluster_util import Cluster
+
+# -- pure planning -------------------------------------------------------------
+
+
+def test_balanced_distribution_favors_free_slots():
+    nodes = [EcNode("a:1", 10, {}), EcNode("b:1", 3, {}),
+             EcNode("c:1", 1, {})]
+    plan = ec_common.balanced_distribution(nodes)
+    assert sum(len(s) for s in plan.values()) == TOTAL_SHARDS
+    assert sorted(sid for s in plan.values() for sid in s) == \
+        list(range(TOTAL_SHARDS))
+    assert len(plan["a:1"]) > len(plan["b:1"]) > len(plan["c:1"])
+
+
+def test_balanced_distribution_single_node_takes_all():
+    plan = ec_common.balanced_distribution([EcNode("a:1", 50, {})])
+    assert plan == {"a:1": list(range(TOTAL_SHARDS))}
+
+
+def test_plan_dedupe_keeps_least_loaded_copy():
+    nodes = [
+        EcNode("a:1", 5, {7: ShardBits.of(0, 1, 2, 3)}),
+        EcNode("b:1", 5, {7: ShardBits.of(0)}),
+    ]
+    deletes = ec_common.plan_dedupe(nodes)
+    # shard 0 duplicated; the copy on the busier node (a) goes
+    assert deletes == [(7, 0, "a:1")]
+
+
+def test_plan_balance_evens_counts():
+    nodes = [
+        EcNode("a:1", 5, {1: ShardBits.of(*range(10))}),
+        EcNode("b:1", 5, {1: ShardBits.of(10, 11, 12, 13)}),
+        EcNode("c:1", 5, {}),
+    ]
+    moves = ec_common.plan_balance(nodes)
+    counts = {"a:1": 10, "b:1": 4, "c:1": 0}
+    for mv in moves:
+        counts[mv.src] -= len(mv.shard_ids)
+        counts[mv.dst] += len(mv.shard_ids)
+    assert max(counts.values()) - min(counts.values()) <= 1
+    # no move may duplicate a shard on its destination
+    held = {"a:1": set(range(10)), "b:1": {10, 11, 12, 13}, "c:1": set()}
+    for mv in moves:
+        for sid in mv.shard_ids:
+            assert sid not in held[mv.dst]
+            held[mv.src].discard(sid)
+            held[mv.dst].add(sid)
+
+
+def test_missing_shards():
+    nodes = [EcNode("a:1", 5, {3: ShardBits.of(*range(12))})]
+    assert ec_common.missing_shards(nodes, 3) == [12, 13]
+
+
+def test_plan_volume_balance():
+    counts = {"a:1": [1, 2, 3, 4, 5, 6], "b:1": [7], "c:1": []}
+    maxes = {"a:1": 10, "b:1": 10, "c:1": 10}
+    moves = plan_volume_balance(counts, maxes)
+    final = {u: len(v) for u, v in counts.items()}
+    for mv in moves:
+        final[mv.src] -= 1
+        final[mv.dst] += 1
+    assert max(final.values()) - min(final.values()) <= 1
+
+
+def test_plan_fix_replication():
+    # vid 5 wants 2 copies (placement 001 -> byte 1) but has 1
+    replicas = {5: [("a:1", 1)], 6: [("a:1", 0)]}
+    fixes = plan_fix_replication(replicas, ["a:1", "b:1"])
+    assert fixes == [(5, "a:1", "b:1")]
+
+
+# -- live cluster --------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("shellcluster"), n_volume_servers=3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def shell(cluster):
+    return Shell(cluster.master.url)
+
+
+def _fill_volume(cluster, collection, n=5, size=2048):
+    datas = [os.urandom(size) for _ in range(n)]
+    fids = [cluster.upload(d, collection=collection) for d in datas]
+    vid = parse_fid(fids[0]).volume_id
+    keep = [(f, d) for f, d in zip(fids, datas)
+            if parse_fid(f).volume_id == vid]
+    return vid, keep
+
+
+def test_shell_help_lists_commands(shell):
+    txt = shell.run_command("help")
+    for name in ("ec.encode", "ec.rebuild", "ec.balance", "ec.decode",
+                 "volume.balance", "volume.fix.replication", "volume.list"):
+        assert name in txt
+
+
+def test_ec_encode_spreads_and_serves(cluster, shell):
+    vid, keep = _fill_volume(cluster, "shenc")
+    out = shell.run_command(f"ec.encode -volumeId={vid} -encoder=numpy")
+    assert "done" in out
+    # shards spread across several nodes
+    bits = cluster.wait_for(lambda: cluster.master.topo.lookup_ec(vid),
+                            what="ec registration")
+    assert len(bits) >= 2, f"expected spread, got {bits}"
+    total = ShardBits(0)
+    for b in bits.values():
+        total = total.plus(b)
+    assert total.count == TOTAL_SHARDS
+    # original volume is gone; reads go through EC
+    assert cluster.master.topo.lookup(vid, "shenc") == []
+    for fid, d in keep:
+        with cluster.fetch(fid) as r:
+            assert r.read() == d
+
+
+def test_ec_rebuild_after_loss(cluster, shell):
+    vid, keep = _fill_volume(cluster, "shreb")
+    shell.run_command(f"ec.encode -volumeId={vid} -encoder=numpy")
+    cluster.wait_for(lambda: cluster.master.topo.lookup_ec(vid),
+                     what="ec registration")
+
+    # lose up to 4 shards (the RS(10,4) tolerance) from one holder
+    from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+    bits = cluster.master.topo.lookup_ec(vid)
+    victim_url, victim_bits = next(iter(bits.items()))
+    lost = victim_bits.shard_ids[:4]
+    stub = volume_stub(victim_url)
+    stub.VolumeEcShardsUnmount(volume_server_pb2.VolumeEcShardsUnmountRequest(
+        volume_id=vid, shard_ids=lost))
+    stub.VolumeEcShardsDelete(volume_server_pb2.VolumeEcShardsDeleteRequest(
+        volume_id=vid, collection="shreb", shard_ids=lost))
+    def loss_visible():
+        b = cluster.master.topo.lookup_ec(vid).get(victim_url)
+        return b is None or not any(b.has(s) for s in lost)
+    cluster.wait_for(loss_visible, what="shard loss visible")
+
+    out = shell.run_command("ec.rebuild -encoder=numpy")
+    assert f"volume {vid}" in out
+
+    def all_back():
+        total = ShardBits(0)
+        for b in cluster.master.topo.lookup_ec(vid).values():
+            total = total.plus(b)
+        return total.count == TOTAL_SHARDS
+    cluster.wait_for(all_back, what="all 14 shards back")
+    for fid, d in keep:
+        with cluster.fetch(fid) as r:
+            assert r.read() == d
+
+
+def test_ec_balance_dry_run_then_apply(cluster, shell):
+    out = shell.run_command("ec.balance")
+    assert "dry run" in out
+    out = shell.run_command("ec.balance -apply")
+    assert "dry run" not in out
+
+
+def test_bad_flags_keep_shell_alive(shell):
+    from seaweedfs_tpu.shell import CommandError
+    with pytest.raises(CommandError):
+        shell.run_command("ec.encode -notAFlag")
+    assert "ec.encode" in shell.run_command("help")
+
+
+def test_ec_decode_roundtrip(cluster, shell):
+    vid, keep = _fill_volume(cluster, "shdec")
+    shell.run_command(f"ec.encode -volumeId={vid} -encoder=numpy")
+    cluster.wait_for(lambda: cluster.master.topo.lookup_ec(vid),
+                     what="ec registration")
+    out = shell.run_command(f"ec.decode -volumeId={vid}")
+    assert "decoded" in out
+    cluster.wait_for(lambda: cluster.master.topo.lookup(vid, "shdec"),
+                     what="normal volume back")
+    cluster.wait_for(lambda: not cluster.master.topo.lookup_ec(vid),
+                     what="ec shards unregistered")
+    for fid, d in keep:
+        with cluster.fetch(fid) as r:
+            assert r.read() == d
+
+
+def test_volume_fix_replication_restores_copy(cluster, shell):
+    data = os.urandom(512)
+    fid = cluster.upload(data, replication="001")
+    vid = parse_fid(fid).volume_id
+    locs = cluster.wait_for(
+        lambda: (len(cluster.master.lookup_locations(vid)) == 2
+                 and cluster.master.lookup_locations(vid)),
+        what="two replicas")
+    # drop one replica
+    from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+    volume_stub(locs[1][0]).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+    cluster.wait_for(
+        lambda: len(cluster.master.lookup_locations(vid)) == 1,
+        what="replica loss visible")
+    out = shell.run_command("volume.fix.replication")
+    assert f"volume {vid}" in out
+    cluster.wait_for(
+        lambda: len(cluster.master.lookup_locations(vid)) == 2,
+        what="replica restored")
+    with cluster.fetch(fid) as r:
+        assert r.read() == data
+
+
+def test_volume_list_and_cluster_status(cluster, shell):
+    assert "DataNode" in shell.run_command("volume.list")
+    assert "master:" in shell.run_command("cluster.status")

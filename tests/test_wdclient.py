@@ -1,0 +1,58 @@
+"""MasterClient / VidMap: the KeepConnected-fed location cache
+(reference weed/wdclient)."""
+
+import pytest
+
+from seaweedfs_tpu.operation import operations
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.wdclient import MasterClient, VidMap
+from seaweedfs_tpu.wdclient.vid_map import Location
+from tests.cluster_util import Cluster
+
+
+def test_vid_map_basics():
+    m = VidMap()
+    m.add_location(3, Location("a:1", "a:1"))
+    m.add_location(3, Location("b:1", "b:1"))
+    m.add_location(3, Location("a:1", "a:1"))  # dedupe
+    assert len(m.lookup(3)) == 2
+    assert m.lookup_file_id("3,017b2c8f12").startswith(("a:1/", "b:1/"))
+    m.delete_location(3, "a:1")
+    assert [l.url for l in m.lookup(3)] == ["b:1"]
+    m.drop_node("b:1")
+    assert m.lookup(3) == []
+    with pytest.raises(KeyError):
+        m.lookup_file_id("3,017b2c8f12")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("wdcluster"), n_volume_servers=2)
+    yield c
+    c.stop()
+
+
+def test_master_client_tracks_new_volumes(cluster):
+    mc = MasterClient([cluster.master.url], "test-wd").start()
+    try:
+        mc.wait_until_connected()
+        fid = operations.upload(cluster.master.url, b"wd-payload",
+                                collection="wd")
+        vid = parse_fid(fid).volume_id
+        cluster.wait_for(lambda: mc.vid_map.lookup(vid),
+                         what="delta reaches client cache")
+        url = mc.lookup_file_id(fid)
+        with cluster.http(url) as r:
+            assert r.read() == b"wd-payload"
+    finally:
+        mc.stop()
+
+
+def test_operations_roundtrip(cluster):
+    fid = operations.upload(cluster.master.url, b"op-data",
+                            filename="op.bin", mime="application/x-op")
+    assert operations.download(cluster.master.url, fid) == b"op-data"
+    results = operations.delete_files(cluster.master.url, [fid])
+    assert results[0]["status"] == 202
+    with pytest.raises(Exception):
+        operations.download(cluster.master.url, fid)
