@@ -46,7 +46,6 @@ def upload_data(url_fid: str, data: bytes, filename: str = "",
     headers = {}
     if gzip:
         data = gzip_mod.compress(data)
-        headers["Content-Encoding"] = "gzip"
     boundary = "sw-" + secrets.token_hex(16)  # collision-proof framing
     disp = f'form-data; name="file"'
     if filename:
@@ -54,6 +53,10 @@ def upload_data(url_fid: str, data: bytes, filename: str = "",
     part_headers = f"Content-Disposition: {disp}\r\n"
     if mime:
         part_headers += f"Content-Type: {mime}\r\n"
+    if gzip:
+        # part-level marker so the server stores the needle with the
+        # compressed flag and the read path can undo it
+        part_headers += "Content-Encoding: gzip\r\n"
     body = (f"--{boundary}\r\n{part_headers}\r\n").encode() + data + \
         f"\r\n--{boundary}--\r\n".encode()
     headers["Content-Type"] = f"multipart/form-data; boundary={boundary}"
@@ -95,7 +98,10 @@ def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
         raise RuntimeError(f"no locations for {fid}")
     with urllib.request.urlopen(f"http://{urls[0]}/{fid}",
                                 timeout=timeout) as r:
-        return r.read()
+        data = r.read()
+        if r.headers.get("Content-Encoding") == "gzip":
+            data = gzip_mod.decompress(data)
+        return data
 
 
 def delete_file(master_url: str, fid: str, timeout: float = 30.0) -> None:

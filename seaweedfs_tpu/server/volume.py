@@ -33,7 +33,8 @@ from seaweedfs_tpu.pb import (master_pb2, master_stub, volume_server_pb2,
                               volume_stub)
 from seaweedfs_tpu.server import convert
 from seaweedfs_tpu.storage import vacuum as vacuum_mod
-from seaweedfs_tpu.storage.needle import CookieMismatch, Needle, NeedleError
+from seaweedfs_tpu.storage.needle import (FLAG_IS_COMPRESSED, CookieMismatch,
+                                          Needle, NeedleError)
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.superblock import TTL
 
@@ -271,7 +272,9 @@ class VolumeServer:
                     got = self._read_needle(f.volume_id, n)
                     if got.cookie != f.cookie:
                         raise CookieMismatch(f"cookie mismatch on {fid}")
-                size = self._delete_needle(f.volume_id, n)
+                # replicated like the HTTP DELETE path, so the needle
+                # disappears from every replica, not just this server
+                size = self.replicated_delete(f.volume_id, n)
                 results.append(volume_server_pb2.DeleteResult(
                     file_id=fid, status=202, size=size))
             except CookieMismatch as e:
@@ -577,8 +580,9 @@ class VolumeServer:
 
 
 def parse_multipart(content_type: str, body: bytes):
-    """Minimal multipart/form-data parser: returns (filename, mime, data)
-    of the first file part (reference needle_parse_upload.go)."""
+    """Minimal multipart/form-data parser: returns (filename, mime, data,
+    encoding) of the first file part, where encoding is the part's
+    Content-Encoding (reference needle_parse_upload.go)."""
     boundary = None
     for piece in content_type.split(";"):
         piece = piece.strip()
@@ -611,10 +615,11 @@ def parse_multipart(content_type: str, body: bytes):
             if item.startswith("filename="):
                 filename = item[len("filename="):].strip('"')
         mime = headers.get("content-type", "")
+        encoding = headers.get("content-encoding", "")
         if filename:
-            return filename, mime, data
+            return filename, mime, data, encoding
         if fallback is None:
-            fallback = ("", mime, data)
+            fallback = ("", mime, data, encoding)
     if fallback is None:
         raise ValueError("empty multipart body")
     return fallback
@@ -765,15 +770,20 @@ def _make_http_handler(vs: VolumeServer):
                 return
             body = self._body()
             ctype = self.headers.get("Content-Type") or ""
+            encoding = self.headers.get("Content-Encoding") or ""
             filename, mime, data = "", ctype, body
             if ctype.startswith("multipart/form-data"):
                 try:
-                    filename, mime, data = parse_multipart(ctype, body)
+                    filename, mime, data, part_enc = \
+                        parse_multipart(ctype, body)
                 except ValueError as e:
                     self._json({"error": str(e)}, code=400)
                     return
+                encoding = part_enc or encoding
             ttl_s = params.get("ttl", [""])[0]
             n = Needle(id=f.key, cookie=f.cookie, data=data,
+                       flags=FLAG_IS_COMPRESSED
+                       if encoding.lower() == "gzip" else 0,
                        name=filename.encode() if filename else b"",
                        mime=mime.encode() if mime and
                        mime != "application/octet-stream" else b"",

@@ -34,7 +34,12 @@ from seaweedfs_tpu.shell import command_volume  # noqa: E402,F401
 
 
 class CommandError(Exception):
-    pass
+    """Command failure; .partial holds output written before the error
+    so the operator can see which irreversible steps already ran."""
+
+    def __init__(self, message: str, partial: str = ""):
+        super().__init__(message)
+        self.partial = partial
 
 
 class Shell:
@@ -58,7 +63,15 @@ class Shell:
         except SystemExit:
             # argparse exits on bad flags/-h; keep the shell alive
             raise CommandError(
-                f"bad arguments for {name}: {' '.join(args)!r}") from None
+                f"bad arguments for {name}: {' '.join(args)!r}",
+                partial=out.getvalue()) from None
+        except CommandError as e:
+            raise CommandError(str(e), partial=out.getvalue() + e.partial) \
+                from None
+        except Exception as e:
+            # surface what already happened before the failure
+            raise CommandError(f"{type(e).__name__}: {e}",
+                               partial=out.getvalue()) from e
         return out.getvalue()
 
     def repl(self, input_fn=input, print_fn=print) -> None:
@@ -73,6 +86,8 @@ class Shell:
             try:
                 print_fn(self.run_command(line), end="")
             except CommandError as e:
+                if e.partial:
+                    print_fn(e.partial, end="")
                 print_fn(f"error: {e}")
             except Exception as e:  # keep the repl alive
                 print_fn(f"error: {type(e).__name__}: {e}")

@@ -37,8 +37,14 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
         return
     env.acquire_lock()
     try:
+        # one topology snapshot for collection lookups, not one per vid
+        collections = {v: replicas[0].info.collection
+                       for v, replicas in
+                       env.collect_volume_replicas().items()}
         for vid in vids:
-            _do_ec_encode(env, vid, args.collection, encoder, out)
+            _do_ec_encode(env, vid,
+                          args.collection or collections.get(vid, ""),
+                          encoder, out)
     finally:
         env.release_lock()
 
@@ -62,7 +68,6 @@ def _do_ec_encode(env: CommandEnv, vid: int, collection: str,
     if not replicas:
         out.write(f"volume {vid}: no locations\n")
         return
-    collection = collection or _volume_collection(env, vid)
     # 1. freeze writes on every replica
     for url in replicas:
         env.volume_server(url).VolumeMarkReadonly(
@@ -84,13 +89,6 @@ def _do_ec_encode(env: CommandEnv, vid: int, collection: str,
     out.write(f"volume {vid}: ec.encode done "
               f"({sum(len(s) for s in plan.values())} shards on "
               f"{len(plan)} nodes)\n")
-
-
-def _volume_collection(env: CommandEnv, vid: int) -> str:
-    for v, replicas in env.collect_volume_replicas().items():
-        if v == vid:
-            return replicas[0].info.collection
-    return ""
 
 
 def _spread_ec_shards(env: CommandEnv, vid: int, collection: str,
@@ -130,6 +128,7 @@ def ec_rebuild(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()
     try:
         nodes = env.collect_ec_nodes()
+        collections = _ec_collections(env)  # one topology RPC for all vids
         vids = sorted({vid for n in nodes for vid in n.shards})
         for vid in vids:
             missing = ec_common.missing_shards(nodes, vid)
@@ -140,15 +139,16 @@ def ec_rebuild(env: CommandEnv, argv: List[str], out) -> None:
                           f"{TOTAL_SHARDS - len(missing)} shards left, "
                           f"cannot rebuild\n")
                 continue
-            _rebuild_one(env, nodes, vid, missing, encoder, out)
+            _rebuild_one(env, nodes, vid, missing, encoder,
+                         collections.get(vid, ""), out)
     finally:
         env.release_lock()
 
 
 def _rebuild_one(env: CommandEnv, nodes: List[EcNode], vid: int,
-                 missing: List[int], encoder: str, out) -> None:
+                 missing: List[int], encoder: str, collection: str,
+                 out) -> None:
     rebuilder = ec_common.pick_rebuilder(nodes)
-    collection = _ec_collection(env, vid)
     local = rebuilder.shards.get(vid, ShardBits(0))
     # pull enough foreign shards (files only, no mount) to reach >=10
     pulled = []
@@ -193,10 +193,6 @@ def _ec_collections(env: CommandEnv) -> Dict[int, str]:
         for e in dn.ec_shard_infos:
             out.setdefault(e.id, e.collection)
     return out
-
-
-def _ec_collection(env: CommandEnv, vid: int) -> str:
-    return _ec_collections(env).get(vid, "")
 
 
 @command("ec.balance", "dedupe and spread EC shards evenly over nodes")
@@ -264,20 +260,37 @@ def ec_decode(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()
     try:
         nodes = env.collect_ec_nodes()
+        collections = _ec_collections(env)  # one topology RPC for all vids
         vids = [args.volumeId] if args.volumeId else \
             sorted({vid for n in nodes for vid in n.shards})
+        failed = []
         for vid in vids:
-            _decode_one(env, nodes, vid, out)
+            try:
+                _decode_one(env, nodes, vid, collections.get(vid, ""), out)
+            except Exception as e:  # keep decoding the other volumes
+                failed.append(vid)
+                out.write(f"volume {vid}: decode failed: {e}\n")
+        if failed:
+            raise RuntimeError(f"ec.decode failed for volumes {failed}")
     finally:
         env.release_lock()
 
 
-def _decode_one(env: CommandEnv, nodes: List[EcNode], vid: int, out) -> None:
+def _decode_one(env: CommandEnv, nodes: List[EcNode], vid: int,
+                collection: str, out) -> None:
     holders = [n for n in nodes if vid in n.shards]
     if not holders:
         out.write(f"volume {vid}: no ec shards\n")
         return
-    collection = _ec_collection(env, vid)
+    # decodability pre-check BEFORE any destructive unmount: need >=10
+    # distinct shards somewhere in the cluster
+    distinct = set()
+    for n in holders:
+        distinct.update(n.shards[vid].shard_ids)
+    if len(distinct) < DATA_SHARDS:
+        out.write(f"volume {vid}: only {len(distinct)} distinct shards, "
+                  f"cannot decode\n")
+        return
     target = max(holders, key=lambda n: n.shards[vid].count)
     local = target.shards[vid]
     # pull shards until the target can decode: either all 10 data

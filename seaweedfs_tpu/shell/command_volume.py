@@ -119,13 +119,25 @@ def volume_balance(env: CommandEnv, argv: List[str], out) -> None:
 
 
 def _move_volume(env: CommandEnv, mv: VolumeMove, out) -> None:
-    """copy to dst (pull from src), then delete from src — the
-    reference's volume.move ordering (command_volume_move.go)."""
-    env.volume_server(mv.dst).VolumeCopy(
-        volume_server_pb2.VolumeCopyRequest(
-            volume_id=mv.vid, source_data_node=mv.src))
+    """freeze writes on src, copy to dst (pull from src), delete from
+    src, unfreeze on dst — the reference's volume.move ordering
+    (command_volume_move.go). Without the readonly fence a write landing
+    on src between copy and delete would be lost."""
+    env.volume_server(mv.src).VolumeMarkReadonly(
+        volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=mv.vid))
+    try:
+        env.volume_server(mv.dst).VolumeCopy(
+            volume_server_pb2.VolumeCopyRequest(
+                volume_id=mv.vid, source_data_node=mv.src))
+    except Exception:
+        # copy failed: unfreeze the source so it keeps serving writes
+        env.volume_server(mv.src).VolumeMarkWritable(
+            volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
+        raise
     env.volume_server(mv.src).VolumeDelete(
         volume_server_pb2.VolumeDeleteRequest(volume_id=mv.vid))
+    env.volume_server(mv.dst).VolumeMarkWritable(
+        volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
     out.write(f"volume {mv.vid}: moved {mv.src} -> {mv.dst}\n")
 
 

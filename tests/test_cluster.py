@@ -259,3 +259,39 @@ def test_ec_decode_back_to_volume(cluster):
     for fid, d in keep:
         with cluster.fetch(fid) as r:
             assert r.read() == d
+
+
+def test_gzip_upload_stores_compressed_flag(cluster):
+    """upload_data(gzip=True) must round-trip: the server marks the
+    needle compressed and the read path decompresses for plain
+    clients (regression: gzip bytes used to be served verbatim)."""
+    from seaweedfs_tpu.operation import operations
+    data = b"compress me " * 500
+    a = operations.assign(cluster.master.url)
+    operations.upload_data(f"{a.url}/{a.fid}", data, filename="x.txt",
+                           mime="text/plain", gzip=True)
+    assert operations.download(cluster.master.url, a.fid) == data
+    # and a gzip-accepting client gets the stored bytes verbatim
+    with cluster.http(f"{a.url}/{a.fid}",
+                      headers={"Accept-Encoding": "gzip"}) as r:
+        assert r.headers.get("Content-Encoding") == "gzip"
+        import gzip as gz
+        assert gz.decompress(r.read()) == data
+
+
+def test_batch_delete_removes_all_replicas(cluster):
+    """delete_files must delete from every replica, not just the one
+    server it talks to (regression: replicas used to survive)."""
+    from seaweedfs_tpu.operation import operations
+    fid = cluster.upload(b"doomed", replication="001")
+    vid = parse_fid(fid).volume_id
+    urls = cluster.wait_for(
+        lambda: (lambda u: u if len(u) == 2 else None)(
+            operations.lookup(cluster.master.url, vid)),
+        what="two replicas registered")
+    results = operations.delete_files(cluster.master.url, [fid])
+    assert results and results[0]["status"] == 202, results
+    for url in urls:  # gone from BOTH replicas
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            cluster.http(f"{url}/{fid}")
+        assert ei.value.code == 404

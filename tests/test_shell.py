@@ -231,3 +231,42 @@ def test_volume_fix_replication_restores_copy(cluster, shell):
 def test_volume_list_and_cluster_status(cluster, shell):
     assert "DataNode" in shell.run_command("volume.list")
     assert "master:" in shell.run_command("cluster.status")
+
+
+def test_command_error_preserves_partial_output(shell):
+    """A command failing mid-run must still surface what it already
+    did (regression: the audit trail used to be swallowed)."""
+    from seaweedfs_tpu.shell import COMMANDS, CommandError, command
+
+    @command("test.partial", "writes then explodes")
+    def _partial(env, argv, out):
+        out.write("step 1 done\n")
+        raise RuntimeError("boom")
+
+    try:
+        with pytest.raises(CommandError) as ei:
+            shell.run_command("test.partial")
+        assert ei.value.partial == "step 1 done\n"
+        assert "boom" in str(ei.value)
+    finally:
+        COMMANDS.pop("test.partial", None)
+
+
+def test_volume_move_fences_writes(cluster, shell):
+    """volume.move must mark the source readonly before copying and
+    leave the destination writable (regression: a write racing the
+    copy used to be lost silently)."""
+    from seaweedfs_tpu.operation import operations
+    fid = cluster.upload(b"move me")
+    vid = parse_fid(fid).volume_id
+    src = operations.lookup(cluster.master.url, vid)[0]
+    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    shell.run_command(f"volume.move -volumeId={vid} "
+                      f"-source={src} -target={dst}")
+    cluster.wait_for(
+        lambda: operations.lookup(cluster.master.url, vid) == [dst],
+        what="master sees the move")
+    assert operations.download(cluster.master.url, fid) == b"move me"
+    # destination must accept writes again
+    dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
+    assert not dst_vs.store.find_volume(vid).read_only
