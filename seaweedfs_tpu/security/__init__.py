@@ -1,0 +1,6 @@
+"""JWT auth + access guard (reference: weed/security)."""
+
+from seaweedfs_tpu.security.jwt import (  # noqa: F401
+    SigningKey, decode_jwt, encode_jwt, gen_jwt_for_file_id,
+)
+from seaweedfs_tpu.security.guard import Guard  # noqa: F401

@@ -1,0 +1,6 @@
+"""Prometheus-style metrics (reference: weed/stats)."""
+
+from seaweedfs_tpu.stats.metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry, REGISTRY,
+    start_metrics_server,
+)

@@ -1,0 +1,269 @@
+"""Minimal Prometheus client: counters/gauges/histograms with labels and
+text exposition over HTTP (reference: weed/stats/metrics.go:21-182).
+
+The reference registers request counters + latency histograms for
+master/volume/filer/S3 and exposes them by pull (`-metricsPort`) or by
+pushing to a gateway. Same surface here, implemented directly (the
+prometheus_client package is not in the image).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, Optional, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...],
+                extra: str = "") -> str:
+    parts = [f'{n}="{v}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, *values: str):
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(f"{self.name}: want {self.label_names}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._new_child()
+                self._children[values] = child
+            return child
+
+    def _default(self):
+        return self.labels() if not self.label_names else None
+
+    def collect(self) -> str:
+        raise NotImplementedError
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._children.items())
+        for values, child in items:
+            lines.append(f"{self.name}"
+                         f"{_fmt_labels(self.label_names, values)}"
+                         f" {child.value}")
+        return "\n".join(lines)
+
+
+class _GaugeChild(_CounterChild):
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.total += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, child):
+        self.child = child
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.child.observe(time.perf_counter() - self.t0)
+        return False
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names=(),
+                 buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(buckets)
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def collect(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._children.items())
+        for values, child in items:
+            for b, c in zip(child.buckets, child.counts):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, values, f'le=\"{b}\"')}"
+                    f" {c}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.label_names, values, 'le=\"+Inf\"')}"
+                f" {child.count}")
+            lines.append(f"{self.name}_sum"
+                         f"{_fmt_labels(self.label_names, values)}"
+                         f" {child.total}")
+            lines.append(f"{self.name}_count"
+                         f"{_fmt_labels(self.label_names, values)}"
+                         f" {child.count}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            return self._metrics.setdefault(metric.name, metric)
+
+    def counter(self, name, help_text="", label_names=()) -> Counter:
+        return self.register(Counter(name, help_text, label_names))
+
+    def gauge(self, name, help_text="", label_names=()) -> Gauge:
+        return self.register(Gauge(name, help_text, label_names))
+
+    def histogram(self, name, help_text="", label_names=(),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_text, label_names, buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.collect() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# The reference's metric families (stats/metrics.go:21-127), shared by
+# every server role in-process.
+RequestCounter = REGISTRY.counter(
+    "SeaweedFS_request_total", "number of requests", ("type", "name"))
+RequestHistogram = REGISTRY.histogram(
+    "SeaweedFS_request_seconds", "request latency", ("type", "name"))
+VolumeServerVolumeCounter = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_volumes", "volume count", ("collection", "type"))
+VolumeServerDiskSizeGauge = REGISTRY.gauge(
+    "SeaweedFS_volumeServer_total_disk_size", "disk size", ("collection", "type"))
+
+
+def start_metrics_server(port: int, registry: Registry = REGISTRY,
+                         ip: str = "") -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((ip, port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True,
+                     name=f"metrics-{port}").start()
+    return srv
+
+
+def loop_pushing_metric(name: str, instance: str, addr: str,
+                        interval_seconds: int,
+                        registry: Registry = REGISTRY,
+                        stop_event: Optional[threading.Event] = None) -> threading.Thread:
+    """Push-gateway loop (reference: stats/metrics.go:149)."""
+    url = f"http://{addr}/metrics/job/{name}/instance/{instance}"
+
+    def loop():
+        while not (stop_event and stop_event.is_set()):
+            try:
+                req = urllib.request.Request(
+                    url, data=registry.render().encode(), method="PUT")
+                urllib.request.urlopen(req, timeout=5).close()
+            except OSError:
+                pass
+            if stop_event:
+                if stop_event.wait(interval_seconds):
+                    break
+            else:
+                time.sleep(interval_seconds)
+
+    t = threading.Thread(target=loop, daemon=True, name="metrics-push")
+    t.start()
+    return t

@@ -1,0 +1,37 @@
+"""Chunk encryption: AES-256-GCM (reference: weed/util/cipher.go).
+
+Each chunk gets a fresh random key; the key lives in filer metadata
+(FileChunk.cipher_key), never on the volume server. The nonce is
+prepended to the ciphertext exactly like the reference's Seal with a
+random nonce prefix.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+class CipherError(Exception):
+    pass
+
+
+def encrypt(data: bytes) -> tuple[bytes, bytes]:
+    """Returns (nonce||ciphertext||tag, key)."""
+    key = os.urandom(KEY_SIZE)
+    nonce = os.urandom(NONCE_SIZE)
+    sealed = AESGCM(key).encrypt(nonce, data, None)
+    return nonce + sealed, key
+
+
+def decrypt(data: bytes, key: bytes) -> bytes:
+    if len(data) < NONCE_SIZE:
+        raise CipherError("ciphertext shorter than nonce")
+    try:
+        return AESGCM(key).decrypt(data[:NONCE_SIZE], data[NONCE_SIZE:], None)
+    except Exception as e:
+        raise CipherError(f"decrypt: {e}") from e

@@ -1,0 +1,61 @@
+"""TOML config loading with the reference's search path
+(reference: weed/util/config.go:20-60; viper → tomllib).
+
+`load_configuration("security")` looks for security.toml in ".",
+"$HOME/.seaweedfs/", "/usr/local/etc/seaweedfs/", "/etc/seaweedfs/".
+Values are addressed viper-style with dotted keys:
+`cfg.get("jwt.signing.key")`.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from typing import Any, List, Optional
+
+SEARCH_PATH = [
+    ".",
+    os.path.join(os.path.expanduser("~"), ".seaweedfs"),
+    "/usr/local/etc/seaweedfs",
+    "/etc/seaweedfs",
+]
+
+
+class Configuration:
+    def __init__(self, data: Optional[dict] = None):
+        self.data = data or {}
+
+    def get(self, dotted_key: str, default: Any = None) -> Any:
+        node: Any = self.data
+        for part in dotted_key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+    def get_string(self, key: str, default: str = "") -> str:
+        v = self.get(key, default)
+        return str(v) if v is not None else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        return bool(self.get(key, default))
+
+    def sub(self, dotted_key: str) -> "Configuration":
+        v = self.get(dotted_key)
+        return Configuration(v if isinstance(v, dict) else {})
+
+    def __bool__(self) -> bool:
+        return bool(self.data)
+
+
+def load_configuration(name: str, required: bool = False,
+                       search_path: Optional[List[str]] = None) -> Configuration:
+    for d in (search_path or SEARCH_PATH):
+        p = os.path.join(d, name + ".toml")
+        if os.path.isfile(p):
+            with open(p, "rb") as f:
+                return Configuration(tomllib.load(f))
+    if required:
+        raise FileNotFoundError(
+            f"missing {name}.toml in {search_path or SEARCH_PATH}")
+    return Configuration({})

@@ -1,0 +1,31 @@
+"""Waterfall retry with backoff (reference: weed/util/retry.go)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class NonRetryableError(Exception):
+    pass
+
+
+def retry(name: str, fn: Callable[[], T], *, times: int = 6,
+          wait_seconds: float = 0.05, backoff: float = 2.0,
+          retryable: Callable[[Exception], bool] = lambda e: True) -> T:
+    wait = wait_seconds
+    last: Exception = RuntimeError(f"{name}: retry never ran")
+    for attempt in range(times):
+        try:
+            return fn()
+        except NonRetryableError:
+            raise
+        except Exception as e:  # noqa: BLE001 - deliberate catch-all retry
+            last = e
+            if not retryable(e) or attempt == times - 1:
+                break
+            time.sleep(wait)
+            wait *= backoff
+    raise last

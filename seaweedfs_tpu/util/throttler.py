@@ -1,0 +1,28 @@
+"""Bandwidth throttler for compaction / EC copy
+(reference: weed/util/throttler.go — -compactionMBps)."""
+
+from __future__ import annotations
+
+import time
+
+
+class Throttler:
+    """Call maybe_slowdown(n) after processing n bytes; sleeps so the
+    average rate stays at or below limit_mbps. 0 disables."""
+
+    def __init__(self, limit_mbps: float = 0.0):
+        self.limit_bps = limit_mbps * 1024 * 1024
+        self._window_start = time.monotonic()
+        self._window_bytes = 0
+
+    def maybe_slowdown(self, n: int) -> None:
+        if self.limit_bps <= 0:
+            return
+        self._window_bytes += n
+        elapsed = time.monotonic() - self._window_start
+        expected = self._window_bytes / self.limit_bps
+        if expected > elapsed:
+            time.sleep(expected - elapsed)
+        if elapsed > 1.0:
+            self._window_start = time.monotonic()
+            self._window_bytes = 0

@@ -1,0 +1,102 @@
+"""JWT / guard / metrics (reference: weed/security, weed/stats)."""
+
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.security import Guard, jwt
+from seaweedfs_tpu.security.guard import AccessDenied
+from seaweedfs_tpu.stats.metrics import Registry, start_metrics_server
+
+
+class TestJwt:
+    def test_round_trip(self):
+        tok = jwt.gen_jwt_for_file_id(b"key", 10, "3,01637037d6")
+        claims = jwt.decode_jwt(b"key", tok)
+        assert claims["fid"] == "3,01637037d6"
+        jwt.verify_file_id_jwt(b"key", tok, "3,01637037d6")
+
+    def test_no_key_means_no_auth(self):
+        assert jwt.gen_jwt_for_file_id(b"", 10, "3,1") == ""
+        jwt.verify_file_id_jwt(None, "", "3,1")  # no-op
+
+    def test_wrong_fid_rejected(self):
+        tok = jwt.gen_jwt_for_file_id(b"key", 10, "3,aaa")
+        with pytest.raises(jwt.JwtError):
+            jwt.verify_file_id_jwt(b"key", tok, "3,bbb")
+
+    def test_bad_signature_rejected(self):
+        tok = jwt.gen_jwt_for_file_id(b"key", 10, "3,aaa")
+        with pytest.raises(jwt.JwtError):
+            jwt.decode_jwt(b"other", tok)
+
+    def test_expiry(self):
+        tok = jwt.encode_jwt(b"k", {"fid": "1,2", "exp": int(time.time()) - 1})
+        with pytest.raises(jwt.JwtError):
+            jwt.decode_jwt(b"k", tok)
+
+
+class TestGuard:
+    def test_whitelist_cidr_and_exact(self):
+        g = Guard(whitelist=["10.0.0.0/8", "192.168.1.5"])
+        g.check_whitelist("10.1.2.3")
+        g.check_whitelist("192.168.1.5")
+        with pytest.raises(AccessDenied):
+            g.check_whitelist("8.8.8.8")
+
+    def test_empty_whitelist_open(self):
+        Guard().check_whitelist("8.8.8.8")
+
+    def test_jwt_gate(self):
+        g = Guard(signing_key=b"k")
+        tok = jwt.encode_jwt(b"k", {"sub": "admin"})
+        assert g.check_jwt(f"Bearer {tok}")["sub"] == "admin"
+        with pytest.raises(AccessDenied):
+            g.check_jwt("")
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_exposition(self):
+        reg = Registry()
+        c = reg.counter("req_total", "requests", ("type", "name"))
+        c.labels("volume", "get").inc()
+        c.labels("volume", "get").inc(2)
+        g = reg.gauge("disk_size", "bytes")
+        g.set(123.0)
+        h = reg.histogram("latency", "secs", ("op",), buckets=(0.1, 1.0))
+        h.labels("read").observe(0.05)
+        h.labels("read").observe(5.0)
+        text = reg.render()
+        assert 'req_total{type="volume",name="get"} 3.0' in text
+        assert "disk_size 123.0" in text
+        assert 'latency_bucket{op="read",le="0.1"} 1' in text
+        assert 'latency_bucket{op="read",le="+Inf"} 2' in text
+        assert 'latency_count{op="read"} 2' in text
+
+    def test_histogram_timer(self):
+        reg = Registry()
+        h = reg.histogram("t", "t", ("op",))
+        with h.labels("x").time():
+            pass
+        assert h.labels("x").count == 1
+
+    def test_registry_dedup(self):
+        reg = Registry()
+        a = reg.counter("same", "h")
+        b = reg.counter("same", "h")
+        assert a is b
+
+    def test_http_exposition(self):
+        reg = Registry()
+        reg.counter("up_total", "x").inc()
+        srv = start_metrics_server(0, registry=reg, ip="127.0.0.1")
+        port = srv.server_address[1]
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+                body = r.read().decode()
+            assert "up_total 1.0" in body
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -1,0 +1,189 @@
+"""Cross-cutting utilities: compression, cipher, log buffer, chunk
+cache, config, throttler, retry (reference: weed/util/*_test.go)."""
+
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import chunk_cache, cipher, compression, config
+from seaweedfs_tpu.util.log_buffer import LogBuffer, LogEntry
+from seaweedfs_tpu.util.retry import NonRetryableError, retry
+from seaweedfs_tpu.util.throttler import Throttler
+
+
+class TestCompression:
+    def test_gzip_round_trip(self):
+        data = b"hello world " * 100
+        out, did = compression.maybe_compress(data, ext=".txt")
+        assert did and compression.is_gzipped(out)
+        assert compression.decompress(out) == data
+
+    def test_small_payload_not_compressed(self):
+        out, did = compression.maybe_compress(b"tiny", ext=".txt")
+        assert not did and out == b"tiny"
+
+    def test_incompressible_ext_skipped(self):
+        data = b"x" * 4096
+        _, did = compression.maybe_compress(data, ext=".jpg")
+        assert not did
+
+    def test_mime_detection(self):
+        assert compression.can_be_compressed("", "text/html")
+        assert compression.can_be_compressed("", "application/json")
+        assert not compression.can_be_compressed("", "video/mp4")
+
+    def test_already_compressed_passthrough(self):
+        blob = compression.compress(b"data " * 200)
+        out, did = compression.maybe_compress(blob, ext=".txt")
+        assert not did
+
+    def test_zstd_round_trip(self):
+        data = b"zstd me " * 500
+        blob = compression.compress(data, method="zstd")
+        assert compression.is_zstd(blob)
+        assert compression.decompress(blob) == data
+
+
+class TestCipher:
+    def test_round_trip(self):
+        sealed, key = cipher.encrypt(b"secret chunk data")
+        assert sealed != b"secret chunk data"
+        assert cipher.decrypt(sealed, key) == b"secret chunk data"
+
+    def test_fresh_key_per_chunk(self):
+        s1, k1 = cipher.encrypt(b"a")
+        s2, k2 = cipher.encrypt(b"a")
+        assert k1 != k2 and s1 != s2
+
+    def test_tamper_detected(self):
+        sealed, key = cipher.encrypt(b"payload")
+        bad = sealed[:-1] + bytes([sealed[-1] ^ 1])
+        with pytest.raises(cipher.CipherError):
+            cipher.decrypt(bad, key)
+
+
+class TestLogBuffer:
+    def test_append_read_monotonic(self):
+        lb = LogBuffer(flush_seconds=60)
+        t1 = lb.add(b"one")
+        t2 = lb.add(b"two", ts_ns=t1)  # dup timestamp forced
+        assert t2 > t1
+        got = lb.read_since(0)
+        assert [e.data for e in got] == [b"one", b"two"]
+        assert lb.read_since(t2) == []
+        lb.close()
+
+    def test_flush_sink_and_catchup(self):
+        flushed = []
+        lb = LogBuffer(flush_seconds=60,
+                       flush_fn=lambda a, b, blob: flushed.append(blob))
+        ts = lb.add(b"ev1")
+        lb.add(b"ev2")
+        lb.flush()
+        assert len(flushed) == 1
+        entries = LogEntry.unpack_stream(flushed[0])
+        assert [e.data for e in entries] == [b"ev1", b"ev2"]
+        # flushed generations stay readable in memory
+        assert [e.data for e in lb.read_since(ts)] == [b"ev2"]
+        lb.close()
+
+    def test_wire_framing_torn_tail(self):
+        blob = LogEntry(5, 0, b"abc").pack()
+        assert [e.data for e in LogEntry.unpack_stream(blob + b"\x00\x00")] \
+            == [b"abc"]
+
+    def test_wait_for_data(self):
+        lb = LogBuffer(flush_seconds=60)
+        assert not lb.wait_for_data(0, timeout=0.05)
+        ts = lb.add(b"x")
+        assert lb.wait_for_data(ts - 1, timeout=0.05)
+        lb.close()
+
+
+class TestChunkCache:
+    def test_memory_lru_eviction(self):
+        c = chunk_cache.MemCache(limit_bytes=10)
+        c.set("a", b"12345")
+        c.set("b", b"12345")
+        c.get("a")               # refresh a
+        c.set("c", b"123")       # evicts b (LRU)
+        assert c.get("b") is None
+        assert c.get("a") == b"12345"
+
+    def test_tiered_disk_round_trip(self, tmp_path):
+        tc = chunk_cache.TieredChunkCache(
+            mem_limit_bytes=4, disk_dir=str(tmp_path), disk_limit_bytes=1 << 20)
+        tc.set("3,01637037d6", b"needle-bytes")
+        # too big for mem (limit 4) so must come from disk
+        assert tc.get("3,01637037d6") == b"needle-bytes"
+
+    def test_disk_reload_from_existing_files(self, tmp_path):
+        t = chunk_cache.DiskTier(str(tmp_path / "t"), 1 << 20)
+        t.set("fid1", b"persisted")
+        t2 = chunk_cache.DiskTier(str(tmp_path / "t"), 1 << 20)
+        assert t2.get("fid1") == b"persisted"
+
+    def test_disk_eviction_by_budget(self, tmp_path):
+        t = chunk_cache.DiskTier(str(tmp_path / "t"), limit_bytes=10)
+        t.set("a", b"123456")
+        t.set("b", b"7890123")   # over budget -> a evicted
+        assert t.get("a") is None
+        assert t.get("b") == b"7890123"
+
+
+class TestConfig:
+    def test_search_path_and_dotted_get(self, tmp_path):
+        (tmp_path / "security.toml").write_text(
+            '[jwt.signing]\nkey = "s3cr3t"\nexpires_after_seconds = 10\n')
+        cfg = config.load_configuration(
+            "security", search_path=[str(tmp_path)])
+        assert cfg.get_string("jwt.signing.key") == "s3cr3t"
+        assert cfg.get("jwt.signing.expires_after_seconds") == 10
+        assert cfg.get("missing.key", 42) == 42
+        assert cfg.sub("jwt.signing").get("key") == "s3cr3t"
+
+    def test_missing_optional_and_required(self, tmp_path):
+        assert not config.load_configuration("nope", search_path=[str(tmp_path)])
+        with pytest.raises(FileNotFoundError):
+            config.load_configuration("nope", required=True,
+                                      search_path=[str(tmp_path)])
+
+
+def test_throttler_limits_rate():
+    th = Throttler(limit_mbps=10)  # 10 MB/s
+    t0 = time.monotonic()
+    for _ in range(10):
+        th.maybe_slowdown(1024 * 1024)  # 10MB total -> ~1s at 10MB/s
+    assert time.monotonic() - t0 >= 0.8
+
+
+def test_throttler_disabled_is_free():
+    th = Throttler(0)
+    t0 = time.monotonic()
+    th.maybe_slowdown(1 << 30)
+    assert time.monotonic() - t0 < 0.05
+
+
+class TestRetry:
+    def test_eventual_success(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert retry("op", fn, wait_seconds=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_non_retryable_breaks_out(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise NonRetryableError("fatal")
+
+        with pytest.raises(NonRetryableError):
+            retry("op", fn, wait_seconds=0.001)
+        assert len(calls) == 1
