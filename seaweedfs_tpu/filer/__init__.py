@@ -1,0 +1,9 @@
+"""Filer: the path→entry namespace over the blob store
+(reference: weed/filer)."""
+
+from seaweedfs_tpu.filer.filer import Filer, FilerError  # noqa: F401
+from seaweedfs_tpu.filer.filerstore import (  # noqa: F401
+    FilerStore, FilerStoreWrapper, NotFound,
+)
+from seaweedfs_tpu.filer.stores.memory_store import MemoryStore  # noqa: F401
+from seaweedfs_tpu.filer.stores.sqlite_store import SqliteStore  # noqa: F401

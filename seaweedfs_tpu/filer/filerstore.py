@@ -1,0 +1,150 @@
+"""FilerStore SPI: pluggable metadata backends
+(reference: weed/filer/filerstore.go:18-41 + filerstore_wrapper.go).
+
+A store maps (directory, name) → serialized filer_pb2.Entry. Directory
+listings iterate names in lexicographic order. Transactions gate the
+atomic-rename subtree move; stores without real transactions provide a
+coarse lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.stats.metrics import REGISTRY
+
+FilerStoreCounter = REGISTRY.counter(
+    "SeaweedFS_filerStore_request_total", "filer store ops",
+    ("store", "op"))
+
+
+class NotFound(KeyError):
+    pass
+
+
+def split_path(full_path: str) -> Tuple[str, str]:
+    """"/a/b/c" → ("/a/b", "c"); "/" → ("/", "")."""
+    full_path = normalize_path(full_path)
+    if full_path == "/":
+        return "/", ""
+    d, _, name = full_path.rpartition("/")
+    return d or "/", name
+
+
+def normalize_path(p: str) -> str:
+    if not p.startswith("/"):
+        p = "/" + p
+    while "//" in p:
+        p = p.replace("//", "/")
+    if len(p) > 1 and p.endswith("/"):
+        p = p[:-1]
+    return p
+
+
+def join_path(directory: str, name: str) -> str:
+    return normalize_path(f"{directory}/{name}")
+
+
+class FilerStore:
+    """SPI. Entries are filer_pb2.Entry; the store persists
+    SerializeToString bytes and must not mutate them."""
+
+    name = "abstract"
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry:
+        raise NotImplementedError  # NotFound when missing
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, directory: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(self, directory: str, start_name: str = "",
+                               inclusive: bool = False, limit: int = 1024,
+                               prefix: str = "") -> List[filer_pb2.Entry]:
+        raise NotImplementedError
+
+    # transactions (subtree rename); default: coarse re-entrant lock
+    def begin_transaction(self) -> None:
+        pass
+
+    def commit_transaction(self) -> None:
+        pass
+
+    def rollback_transaction(self) -> None:
+        pass
+
+    # KV (used by weed mount + msg broker bookkeeping)
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FilerStoreWrapper(FilerStore):
+    """Counts ops per store like filerstore_wrapper.go; single place to
+    add path-prefix translation later."""
+
+    def __init__(self, store: FilerStore):
+        self.store = store
+        self.name = store.name
+
+    def _count(self, op: str):
+        FilerStoreCounter.labels(self.name, op).inc()
+
+    def insert_entry(self, directory, entry):
+        self._count("insert")
+        self.store.insert_entry(directory, entry)
+
+    def update_entry(self, directory, entry):
+        self._count("update")
+        self.store.update_entry(directory, entry)
+
+    def find_entry(self, directory, name):
+        self._count("find")
+        return self.store.find_entry(directory, name)
+
+    def delete_entry(self, directory, name):
+        self._count("delete")
+        self.store.delete_entry(directory, name)
+
+    def delete_folder_children(self, directory):
+        self._count("deleteFolderChildren")
+        self.store.delete_folder_children(directory)
+
+    def list_directory_entries(self, directory, start_name="",
+                               inclusive=False, limit=1024, prefix=""):
+        self._count("list")
+        return self.store.list_directory_entries(
+            directory, start_name, inclusive, limit, prefix)
+
+    def begin_transaction(self):
+        self.store.begin_transaction()
+
+    def commit_transaction(self):
+        self.store.commit_transaction()
+
+    def rollback_transaction(self):
+        self.store.rollback_transaction()
+
+    def kv_put(self, key, value):
+        self.store.kv_put(key, value)
+
+    def kv_get(self, key):
+        return self.store.kv_get(key)
+
+    def close(self):
+        self.store.close()

@@ -1,0 +1,2 @@
+"""Embedded FilerStore backends (reference: weed/filer/{leveldb,
+abstract_sql,...} — 14 backends share one SPI; here: memory + sqlite)."""

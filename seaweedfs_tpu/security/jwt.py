@@ -48,9 +48,16 @@ def decode_jwt(key: SigningKey, token: str) -> dict:
         raise JwtError("malformed token") from None
     signing_input = f"{header}.{payload}".encode()
     want = hmac.new(key, signing_input, hashlib.sha256).digest()
-    if not hmac.compare_digest(want, _unb64(sig)):
-        raise JwtError("bad signature")
-    claims = json.loads(_unb64(payload))
+    try:
+        if not hmac.compare_digest(want, _unb64(sig)):
+            raise JwtError("bad signature")
+        claims = json.loads(_unb64(payload))
+    except JwtError:
+        raise
+    except Exception as e:  # bad base64, bad json, wrong types
+        raise JwtError(f"malformed token: {e}") from None
+    if not isinstance(claims, dict):
+        raise JwtError("claims not an object")
     exp = claims.get("exp")
     if exp is not None and time.time() > exp:
         raise JwtError("token expired")

@@ -1,0 +1,531 @@
+"""Filer server: the namespace server.
+
+HTTP serves the public file path (GET streams chunked content, POST
+auto-chunks uploads across volume servers, DELETE removes entries);
+gRPC serves the SeaweedFiler service incl. metadata subscriptions.
+
+Reference: weed/server/filer_server.go, filer_server_handlers_write_
+autochunk.go:28-300, filer_server_handlers_read.go, filer_grpc_server*.go.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+import grpc
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.filer import (Filer, FilerError, MemoryStore, NotFound,
+                                 SqliteStore, filechunks, stream)
+from seaweedfs_tpu.filer.filechunk_manifest import maybe_manifestize
+from seaweedfs_tpu.filer.filer import entry_expired, new_entry
+from seaweedfs_tpu.filer.filerstore import join_path, split_path
+from seaweedfs_tpu.operation import operations
+from seaweedfs_tpu.pb import filer_pb2, master_pb2, master_stub
+from seaweedfs_tpu.util import compression
+from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
+from seaweedfs_tpu.util.cipher import encrypt
+from seaweedfs_tpu.wdclient.masterclient import MasterClient
+
+DEFAULT_CHUNK_SIZE = 8 << 20   # -maxMB analog
+
+
+class FilerServer:
+    def __init__(self, master_url: str, ip: str = "127.0.0.1",
+                 port: int = 8888, store: str = "memory",
+                 meta_dir: Optional[str] = None,
+                 collection: str = "", replication: str = "",
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 cipher: bool = False,
+                 cache_dir: Optional[str] = None):
+        self.master_url = master_url
+        self.ip = ip
+        self.port = port
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        self.cipher = cipher
+        if store == "memory":
+            backend = MemoryStore()
+        elif store == "sqlite":
+            path = f"{meta_dir}/filer.db" if meta_dir else ":memory:"
+            backend = SqliteStore(path)
+        else:
+            raise ValueError(f"unknown filer store {store!r}")
+        self.filer = Filer(backend,
+                           log_dir=f"{meta_dir}/logs" if meta_dir else None)
+        self.filer.on_delete_chunks = self._delete_chunks_async
+        self.chunk_cache = TieredChunkCache(
+            disk_dir=f"{cache_dir}/chunks" if cache_dir else None)
+        self.master_client = MasterClient(
+            [master_url], client_name=f"filer@{ip}:{port}")
+        self._grpc_server = None
+        self._http_server = None
+        self._http_thread = None
+        self._stopping = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> None:
+        handler = rpc.generic_handler(filer_pb2, "SeaweedFiler", self)
+        self._grpc_server = rpc.make_server(
+            f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
+        self._http_server = ThreadingHTTPServer(
+            (self.ip, self.port), _make_http_handler(self))
+        self._http_thread = threading.Thread(
+            target=self._http_server.serve_forever,
+            name=f"filer-http-{self.port}", daemon=True)
+        self._http_thread.start()
+        self.master_client.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        self.master_client.stop()
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.2)
+        self.filer.close()
+
+    # -- helpers --------------------------------------------------------------
+
+    def _delete_chunks_async(self, chunks: List[filer_pb2.FileChunk]) -> None:
+        fids = [c.file_id for c in chunks if c.file_id]
+        if not fids:
+            return
+
+        def run():
+            try:
+                operations.delete_files(self.master_url, fids)
+            except Exception:
+                pass  # volumes may already be gone; vacuum will reclaim
+
+        threading.Thread(target=run, daemon=True,
+                         name="filer-delete-chunks").start()
+
+    def lookup_fid_urls(self, file_id: str) -> List[str]:
+        vid = int(file_id.split(",")[0])
+        locs = self.master_client.lookup(vid)
+        if locs:
+            return [l.url for l in locs]
+        return operations.lookup(self.master_url, vid)
+
+    def _assign(self, collection: str = "", replication: str = "",
+                ttl_sec: int = 0, data_center: str = ""):
+        return operations.assign(
+            self.master_url,
+            collection=collection or self.collection,
+            replication=replication or self.replication,
+            ttl=ttl_string(ttl_sec),
+            data_center=data_center)
+
+    def upload_to_chunks(self, data: bytes, collection: str = "",
+                         replication: str = "", ttl_sec: int = 0,
+                         mime: str = "") -> List[filer_pb2.FileChunk]:
+        """Split `data` into chunkSize pieces, assign+upload each
+        (reference uploadReaderToChunks)."""
+        chunks: List[filer_pb2.FileChunk] = []
+        for off in range(0, max(len(data), 1), self.chunk_size):
+            piece = data[off:off + self.chunk_size]
+            cipher_key = b""
+            stored = piece
+            if self.cipher:
+                stored, cipher_key = encrypt(piece)
+            a = self._assign(collection, replication, ttl_sec)
+            resp = operations.upload_data(
+                f"{a.url}/{a.fid}", stored, mime=mime)
+            chunks.append(filer_pb2.FileChunk(
+                file_id=a.fid, offset=off, size=len(piece),
+                mtime=time.time_ns(), e_tag=resp.get("eTag", ""),
+                cipher_key=cipher_key))
+            if not piece:  # empty file: single empty chunk, stop
+                break
+        return chunks
+
+    def save_manifest_blob(self, data: bytes) -> filer_pb2.FileChunk:
+        a = self._assign()
+        resp = operations.upload_data(f"{a.url}/{a.fid}", data)
+        return filer_pb2.FileChunk(
+            file_id=a.fid, size=len(data), mtime=time.time_ns(),
+            e_tag=resp.get("eTag", ""))
+
+    # -- gRPC: entry CRUD -----------------------------------------------------
+
+    def LookupDirectoryEntry(self, request, context):
+        try:
+            # Filer.find_entry applies lazy TTL expiry (purge + chunk GC)
+            e = self.filer.find_entry(
+                join_path(request.directory, request.name))
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"{request.directory}/{request.name}")
+        return filer_pb2.LookupDirectoryEntryResponse(entry=e)
+
+    def ListEntries(self, request, context):
+        limit = request.limit or 1024
+        entries = self.filer.list_entries(
+            request.directory,
+            start_name=request.start_from_file_name,
+            inclusive=request.inclusive_start_from,
+            limit=limit, prefix=request.prefix)
+        for e in entries:
+            yield filer_pb2.ListEntriesResponse(entry=e)
+
+    def CreateEntry(self, request, context):
+        try:
+            self.filer.create_entry(request.directory, request.entry,
+                                    o_excl=request.o_excl)
+            return filer_pb2.CreateEntryResponse()
+        except FilerError as e:
+            return filer_pb2.CreateEntryResponse(error=str(e))
+
+    def UpdateEntry(self, request, context):
+        self.filer.update_entry(request.directory, request.entry)
+        return filer_pb2.UpdateEntryResponse()
+
+    def AppendToEntry(self, request, context):
+        self.filer.append_chunks(
+            join_path(request.directory, request.entry_name),
+            list(request.chunks))
+        return filer_pb2.AppendToEntryResponse()
+
+    def DeleteEntry(self, request, context):
+        try:
+            self.filer.delete_entry(
+                join_path(request.directory, request.name),
+                recursive=request.is_recursive,
+                ignore_recursive_error=request.ignore_recursive_error,
+                delete_data=request.is_delete_data)
+            return filer_pb2.DeleteEntryResponse()
+        except FilerError as e:
+            return filer_pb2.DeleteEntryResponse(error=str(e))
+
+    def AtomicRenameEntry(self, request, context):
+        try:
+            self.filer.atomic_rename(
+                request.old_directory, request.old_name,
+                request.new_directory, request.new_name)
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"{request.old_directory}/{request.old_name}")
+        return filer_pb2.AtomicRenameEntryResponse()
+
+    # -- gRPC: volume plumbing ------------------------------------------------
+
+    def AssignVolume(self, request, context):
+        try:
+            a = self._assign(request.collection, request.replication,
+                             request.ttl_sec, request.data_center)
+        except RuntimeError as e:
+            return filer_pb2.AssignVolumeResponse(error=str(e))
+        return filer_pb2.AssignVolumeResponse(
+            file_id=a.fid, url=a.url, public_url=a.public_url,
+            count=a.count,
+            collection=request.collection or self.collection,
+            replication=request.replication or self.replication)
+
+    def LookupVolume(self, request, context):
+        resp = filer_pb2.LookupVolumeResponse()
+        for vid_s in request.volume_ids:
+            try:
+                urls = operations.lookup(self.master_url, int(vid_s))
+            except (RuntimeError, ValueError):
+                urls = []
+            locs = resp.locations_map[vid_s]
+            for u in urls:
+                locs.locations.add(url=u, public_url=u)
+        return resp
+
+    def CollectionList(self, request, context):
+        resp = master_stub(self.master_url).CollectionList(
+            master_pb2.CollectionListRequest(
+                include_normal_volumes=request.include_normal_volumes,
+                include_ec_volumes=request.include_ec_volumes))
+        return filer_pb2.CollectionListResponse(
+            collections=[filer_pb2.Collection(name=c.name)
+                         for c in resp.collections])
+
+    def DeleteCollection(self, request, context):
+        master_stub(self.master_url).CollectionDelete(
+            master_pb2.CollectionDeleteRequest(name=request.collection))
+        return filer_pb2.DeleteCollectionResponse()
+
+    def Statistics(self, request, context):
+        resp = master_stub(self.master_url).Statistics(
+            master_pb2.StatisticsRequest(
+                replication=request.replication,
+                collection=request.collection, ttl=request.ttl))
+        return filer_pb2.StatisticsResponse(
+            total_size=resp.total_size, used_size=resp.used_size,
+            file_count=resp.file_count)
+
+    def GetFilerConfiguration(self, request, context):
+        return filer_pb2.GetFilerConfigurationResponse(
+            masters=[self.master_url], replication=self.replication,
+            collection=self.collection,
+            max_mb=self.chunk_size >> 20,
+            dir_buckets="/buckets", cipher=self.cipher)
+
+    # -- gRPC: subscriptions --------------------------------------------------
+
+    def SubscribeMetadata(self, request, context):
+        since = request.since_ns
+        while context.is_active() and not self._stopping:
+            events = self.filer.meta_log.read_events_since(
+                since, path_prefix=request.path_prefix)
+            for ev in events:
+                yield ev
+                since = max(since, ev.ts_ns)
+            if not events:
+                self.filer.meta_log.wait_for_data(since, timeout=0.5)
+
+    SubscribeLocalMetadata = SubscribeMetadata
+
+    # -- gRPC: KV -------------------------------------------------------------
+
+    def KvGet(self, request, context):
+        v = self.filer.store.kv_get(request.key)
+        if v is None:
+            return filer_pb2.KvGetResponse(error="not found")
+        return filer_pb2.KvGetResponse(value=v)
+
+    def KvPut(self, request, context):
+        self.filer.store.kv_put(request.key, request.value)
+        return filer_pb2.KvPutResponse()
+
+
+# -- HTTP layer ---------------------------------------------------------------
+
+
+def _entry_json(e: filer_pb2.Entry, directory: str) -> dict:
+    return {
+        "FullPath": join_path(directory, e.name),
+        "Mtime": e.attributes.mtime,
+        "Crtime": e.attributes.crtime,
+        "Mode": e.attributes.file_mode,
+        "Uid": e.attributes.uid,
+        "Gid": e.attributes.gid,
+        "Mime": e.attributes.mime,
+        "Replication": e.attributes.replication,
+        "Collection": e.attributes.collection,
+        "TtlSec": e.attributes.ttl_sec,
+        "FileSize": filechunks.total_size(e.chunks),
+        "IsDirectory": e.is_directory,
+        "chunks": len(e.chunks),
+    }
+
+
+def _make_http_handler(fs: FilerServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _reply(self, code: int, body: bytes = b"",
+                   headers: Optional[dict] = None) -> None:
+            self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD" and body:
+                self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200,
+                  headers: Optional[dict] = None) -> None:
+            hs = {"Content-Type": "application/json"}
+            hs.update(headers or {})
+            self._reply(code, json.dumps(obj).encode(), hs)
+
+        def _path_and_params(self):
+            u = urllib.parse.urlparse(self.path)
+            return (urllib.parse.unquote(u.path) or "/",
+                    urllib.parse.parse_qs(u.query))
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n) if n else b""
+
+        # -- read -------------------------------------------------------------
+
+        def do_GET(self):
+            path, params = self._path_and_params()
+            try:
+                entry = fs.filer.find_entry(path)
+            except NotFound:
+                self._json({"error": f"{path} not found"}, code=404)
+                return
+            if entry.is_directory:
+                self._list_dir(path, params)
+                return
+            self._serve_file(path, entry)
+
+        do_HEAD = do_GET
+
+        def _list_dir(self, path: str, params: dict) -> None:
+            try:
+                limit = int(params.get("limit", ["100"])[0])
+            except ValueError:
+                self._json({"error": "bad limit"}, code=400)
+                return
+            last = params.get("lastFileName", [""])[0]
+            entries = fs.filer.list_entries(path, start_name=last,
+                                            inclusive=False, limit=limit)
+            self._json({
+                "Path": path,
+                "Entries": [_entry_json(e, path) for e in entries],
+                "Limit": limit,
+                "LastFileName": entries[-1].name if entries else "",
+                "ShouldDisplayLoadMore": len(entries) == limit,
+            })
+
+        def _serve_file(self, path: str, entry: filer_pb2.Entry) -> None:
+            size = filechunks.total_size(entry.chunks)
+            etag = f'"{filechunks.etag_of_chunks(list(entry.chunks))}"' \
+                if entry.chunks else '""'
+            if self.headers.get("If-None-Match") == etag:
+                self._reply(304)
+                return
+            headers = {"ETag": etag, "Accept-Ranges": "bytes"}
+            if entry.attributes.mime:
+                headers["Content-Type"] = entry.attributes.mime
+            rng = self.headers.get("Range")
+            offset, length, code = 0, size, 200
+            if rng and rng.startswith("bytes="):
+                try:
+                    start_s, _, end_s = rng[len("bytes="):].partition("-")
+                    if not start_s:
+                        offset = max(0, size - int(end_s))
+                        end = size - 1
+                    else:
+                        offset = int(start_s)
+                        end = min(int(end_s) if end_s else size - 1,
+                                  size - 1)
+                    if offset > end or offset < 0:
+                        raise ValueError
+                    length = end - offset + 1
+                    headers["Content-Range"] = \
+                        f"bytes {offset}-{end}/{size}"
+                    code = 206
+                except ValueError:
+                    self._reply(416)
+                    return
+            if self.command == "HEAD":
+                headers["Content-Length"] = str(length)
+                self.send_response(code)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                return
+            try:
+                data = b"".join(stream.stream_content(
+                    fs.lookup_fid_urls, list(entry.chunks), offset,
+                    length, cache=fs.chunk_cache))
+            except IOError as e:
+                self._json({"error": str(e)}, code=500)
+                return
+            self._reply(code, data, headers)
+
+        # -- write ------------------------------------------------------------
+
+        def do_POST(self):
+            path, params = self._path_and_params()
+            body = self._body()
+            ctype = self.headers.get("Content-Type") or ""
+            filename, mime, data = "", ctype, body
+            if ctype.startswith("multipart/form-data"):
+                from seaweedfs_tpu.server.volume import parse_multipart
+                try:
+                    filename, mime, data, enc = parse_multipart(ctype, body)
+                    if enc == "gzip":
+                        data = compression.decompress(data)
+                except ValueError as e:
+                    self._json({"error": str(e)}, code=400)
+                    return
+            if path.endswith("/"):
+                path = path + filename if filename else path[:-1]
+            directory, name = split_path(path)
+            if not name:
+                self._json({"error": "cannot write to /"}, code=400)
+                return
+            collection = params.get("collection", [""])[0]
+            replication = params.get("replication", [""])[0]
+            try:
+                ttl_sec = _parse_ttl_seconds(params.get("ttl", [""])[0])
+            except ValueError:
+                self._json({"error": "bad ttl"}, code=400)
+                return
+            try:
+                chunks = fs.upload_to_chunks(
+                    data, collection=collection, replication=replication,
+                    ttl_sec=ttl_sec, mime=mime)
+                chunks = maybe_manifestize(fs.save_manifest_blob, chunks)
+            except (RuntimeError, OSError) as e:
+                self._json({"error": str(e)}, code=500)
+                return
+            entry = new_entry(
+                name, mime=mime if mime and
+                mime != "application/octet-stream" else "",
+                ttl_sec=ttl_sec, collection=collection,
+                replication=replication)
+            entry.chunks.extend(chunks)
+            try:
+                fs.filer.create_entry(directory, entry)
+            except FilerError as e:
+                self._json({"error": str(e)}, code=500)
+                return
+            self._json({"name": name, "size": len(data)}, code=201,
+                       headers={"ETag": filechunks.etag_of_chunks(chunks)})
+
+        do_PUT = do_POST
+
+        # -- delete -----------------------------------------------------------
+
+        def do_DELETE(self):
+            path, params = self._path_and_params()
+            recursive = params.get("recursive", [""])[0] == "true"
+            ignore = params.get("ignoreRecursiveError", [""])[0] == "true"
+            try:
+                fs.filer.delete_entry(path, recursive=recursive,
+                                      ignore_recursive_error=ignore)
+            except FilerError as e:
+                self._json({"error": str(e)}, code=409)
+                return
+            self._reply(204)
+
+    return Handler
+
+
+def _parse_ttl_seconds(s: str) -> int:
+    if not s:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800,
+             "M": 2592000, "y": 31536000}
+    if s[-1] in units:
+        return int(s[:-1]) * units[s[-1]]
+    return int(s)
+
+
+def ttl_string(ttl_sec: int) -> str:
+    """Seconds → the volume TTL grammar (count ≤ 255 + unit), rounding
+    up to the smallest unit that fits (a volume TTL is one byte count +
+    one byte unit, storage/superblock.py TTL.parse)."""
+    if ttl_sec <= 0:
+        return ""
+    for suffix, secs in (("s", 1), ("m", 60), ("h", 3600), ("d", 86400),
+                         ("w", 604800), ("M", 2592000), ("y", 31536000)):
+        count = -(-ttl_sec // secs)  # ceil: never expire early
+        if count <= 255:
+            return f"{count}{suffix}"
+    return "255y"

@@ -40,7 +40,9 @@ class Cluster:
                  volumes_per_server: int = 50,
                  volume_size_limit_mb: int = 64,
                  pulse_seconds: float = 0.2,
-                 ec_encoder: str = "numpy"):
+                 ec_encoder: str = "numpy",
+                 with_filer: bool = False,
+                 filer_kwargs: Optional[dict] = None):
         self.master = MasterServer(
             port=free_port_pair(),
             meta_dir=str(tmp_path / "master"),
@@ -58,6 +60,14 @@ class Cluster:
                 pulse_seconds=pulse_seconds, ec_encoder=ec_encoder)
             vs.start()
             self.volume_servers.append(vs)
+        self.filer = None
+        if with_filer:
+            from seaweedfs_tpu.server.filer import FilerServer
+            kw = dict(meta_dir=str(tmp_path / "filer"))
+            kw.update(filer_kwargs or {})
+            self.filer = FilerServer(
+                master_url=self.master.url, port=free_port_pair(), **kw)
+            self.filer.start()
         self.wait_for_nodes(n_volume_servers)
 
     def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
@@ -111,7 +121,11 @@ class Cluster:
         return self.http(f"{url}/{fid}", headers=headers)
 
     def stop(self) -> None:
+        # NB: do NOT rpc.close_channels() here — the channel cache is
+        # process-global and other live clusters (module-scoped
+        # fixtures) share it; tests/conftest.py closes it at session end
+        if self.filer is not None:
+            self.filer.stop()
         for vs in self.volume_servers:
             vs.stop()
         self.master.stop()
-        rpc.close_channels()

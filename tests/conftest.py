@@ -23,3 +23,15 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _close_grpc_channels_at_exit():
+    """The gRPC channel cache is process-global; closing it per-cluster
+    would kill channels that other live clusters still use."""
+    yield
+    from seaweedfs_tpu import rpc
+    rpc.close_channels()

@@ -1,0 +1,115 @@
+"""Chunk interval math (reference: weed/filer/filechunks_test.go,
+filechunks2_test.go — heavy coverage of overlap resolution)."""
+
+import pytest
+
+from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.filer.filechunk_manifest import (
+    maybe_manifestize, resolve_chunk_manifest,
+)
+from seaweedfs_tpu.pb import filer_pb2
+
+
+def chunk(fid, offset, size, mtime, **kw):
+    return filer_pb2.FileChunk(file_id=fid, offset=offset, size=size,
+                               mtime=mtime, **kw)
+
+
+class TestVisibleIntervals:
+    def test_single_chunk(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("a", 0, 100, 1)])
+        assert [(x.start, x.stop, x.file_id) for x in v] == [(0, 100, "a")]
+
+    def test_full_overwrite(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("a", 0, 100, 1), chunk("b", 0, 100, 2)])
+        assert [(x.start, x.stop, x.file_id) for x in v] == [(0, 100, "b")]
+
+    def test_newer_middle_splits_older(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("a", 0, 100, 1), chunk("b", 30, 40, 2)])
+        assert [(x.start, x.stop, x.file_id) for x in v] == \
+            [(0, 30, "a"), (30, 70, "b"), (70, 100, "a")]
+        # right remnant reads from inside chunk a at offset 70
+        assert v[2].chunk_offset == 70
+
+    def test_older_does_not_shadow_newer(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("b", 30, 40, 2), chunk("a", 0, 100, 1)])
+        assert [(x.file_id) for x in v] == ["a", "b", "a"]
+
+    def test_adjacent_chunks(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("a", 0, 50, 1), chunk("b", 50, 50, 2)])
+        assert [(x.start, x.stop) for x in v] == [(0, 50), (50, 100)]
+
+    def test_sparse_hole(self):
+        v = filechunks.non_overlapping_visible_intervals(
+            [chunk("a", 0, 10, 1), chunk("b", 100, 10, 2)])
+        assert [(x.start, x.stop) for x in v] == [(0, 10), (100, 110)]
+
+    def test_total_size(self):
+        assert filechunks.total_size(
+            [chunk("a", 0, 10, 1), chunk("b", 100, 10, 2)]) == 110
+        assert filechunks.total_size([]) == 0
+
+
+class TestChunkViews:
+    def test_view_middle_range(self):
+        views = filechunks.view_from_chunks(
+            [chunk("a", 0, 100, 1), chunk("b", 30, 40, 2)], 40, 40)
+        # 40..70 from b (offset 10 inside b), 70..80 from a (offset 70)
+        assert [(v.file_id, v.offset, v.size, v.logic_offset)
+                for v in views] == [("b", 10, 30, 40), ("a", 70, 10, 70)]
+
+    def test_view_whole_file(self):
+        views = filechunks.view_from_chunks(
+            [chunk("a", 0, 50, 1), chunk("b", 50, 50, 2)])
+        assert [(v.file_id, v.is_full_chunk) for v in views] == \
+            [("a", True), ("b", True)]
+
+    def test_compact_finds_garbage(self):
+        chunks = [chunk("a", 0, 100, 1), chunk("b", 0, 100, 2)]
+        compacted, garbage = filechunks.compact_file_chunks(chunks)
+        assert [c.file_id for c in compacted] == ["b"]
+        assert [c.file_id for c in garbage] == ["a"]
+
+    def test_unused_chunks_on_update(self):
+        old = [chunk("a", 0, 10, 1), chunk("b", 10, 10, 1)]
+        new = [chunk("b", 10, 10, 1), chunk("c", 0, 10, 2)]
+        assert [c.file_id for c in
+                filechunks.find_unused_file_chunks(old, new)] == ["a"]
+
+    def test_etag(self):
+        one = [chunk("a", 0, 10, 1, e_tag="abc")]
+        assert filechunks.etag_of_chunks(one) == "abc"
+        two = one + [chunk("b", 10, 10, 1, e_tag="def")]
+        tag = filechunks.etag_of_chunks(two)
+        assert tag.endswith("-2") and len(tag) == 34
+
+
+class TestManifest:
+    def test_manifestize_and_resolve_round_trip(self):
+        blobs = {}
+
+        def save(data: bytes) -> filer_pb2.FileChunk:
+            fid = f"m{len(blobs)}"
+            blobs[fid] = data
+            return filer_pb2.FileChunk(file_id=fid, size=len(data))
+
+        chunks = [chunk(f"c{i}", i * 10, 10, 1) for i in range(25)]
+        folded = maybe_manifestize(save, chunks, batch=10)
+        manifests = [c for c in folded if c.is_chunk_manifest]
+        plain = [c for c in folded if not c.is_chunk_manifest]
+        assert len(manifests) == 2 and len(plain) == 5  # 2×10 + tail 5
+        assert manifests[0].size == 100  # sum of folded chunk sizes
+
+        resolved = resolve_chunk_manifest(
+            lambda c: blobs[c.file_id], folded)
+        assert sorted(c.file_id for c in resolved) == \
+            sorted(c.file_id for c in chunks)
+
+    def test_below_batch_untouched(self):
+        chunks = [chunk(f"c{i}", i * 10, 10, 1) for i in range(5)]
+        assert maybe_manifestize(lambda b: None, chunks, batch=10) == chunks

@@ -100,3 +100,30 @@ class TestMetrics:
         finally:
             srv.shutdown()
             srv.server_close()
+
+
+class TestJwtMalformed:
+    """Regression: malformed tokens must fail as JwtError, never leak
+    binascii/json errors through the auth gate."""
+
+    @pytest.mark.parametrize("token", [
+        "a.b.A",                       # bad-length base64 signature
+        "a.!!!.c",                     # invalid base64 payload
+        "onlyonepart",
+        "a.b",                         # two parts
+        "..",
+    ])
+    def test_garbage_tokens_rejected_cleanly(self, token):
+        with pytest.raises(jwt.JwtError):
+            jwt.decode_jwt(b"key", token)
+
+    def test_non_json_payload(self):
+        import base64
+        payload = base64.urlsafe_b64encode(b"not json").rstrip(b"=").decode()
+        with pytest.raises(jwt.JwtError):
+            jwt.decode_jwt(b"key", f"e30.{payload}.sig")
+
+    def test_guard_maps_to_access_denied(self):
+        g = Guard(signing_key=b"k")
+        with pytest.raises(AccessDenied):
+            g.check_jwt("Bearer a.b.A")
