@@ -1,0 +1,34 @@
+"""Shared HTTP data-path client for talking to a filer server —
+used by the S3 and WebDAV gateways (metadata rides filer gRPC; bulk
+bytes ride the filer's auto-chunking HTTP path)."""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+TIMEOUT = 120.0
+
+
+def filer_url(filer: str, path: str) -> str:
+    return f"http://{filer}{urllib.parse.quote(path)}"
+
+
+def put(filer: str, path: str, data: bytes, mime: str = "") -> Tuple[dict, Dict[str, str]]:
+    """PUT bytes; returns (json body, response headers) — the ETag
+    header carries the chunked etag."""
+    headers = {"Content-Type": mime} if mime else {}
+    req = urllib.request.Request(filer_url(filer, path), data=data,
+                                 method="PUT", headers=headers)
+    with urllib.request.urlopen(req, timeout=TIMEOUT) as r:
+        return json.load(r), dict(r.headers)
+
+
+def get(filer: str, path: str,
+        range_header: Optional[str] = None) -> Tuple[int, bytes, Dict[str, str]]:
+    headers = {"Range": range_header} if range_header else {}
+    req = urllib.request.Request(filer_url(filer, path), headers=headers)
+    with urllib.request.urlopen(req, timeout=TIMEOUT) as r:
+        return r.status, r.read(), dict(r.headers)

@@ -1,0 +1,10 @@
+"""Mount filesystem layer (reference: weed/filesys — bazil.org/fuse).
+
+The FUSE kernel binding is unavailable in this image; the filesystem
+logic (dirty-page write-back, meta cache, node operations) is a plain
+library driven by `Wfs`, with a thin optional libfuse ctypes shim to be
+attached where FUSE exists.
+"""
+
+from seaweedfs_tpu.filesys.dirty_pages import ContinuousIntervals  # noqa: F401
+from seaweedfs_tpu.filesys.wfs import Wfs, FileHandle  # noqa: F401

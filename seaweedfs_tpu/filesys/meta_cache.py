@@ -1,0 +1,109 @@
+"""Local metadata cache for the mount (reference:
+weed/filesys/meta_cache — a local store populated on demand and
+invalidated by the filer's SubscribeMetadata stream)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import grpc
+
+from seaweedfs_tpu.filer.filerstore import NotFound, split_path
+from seaweedfs_tpu.filer.stores.memory_store import MemoryStore
+from seaweedfs_tpu.pb import filer_pb2, filer_stub
+
+
+class MetaCache:
+    def __init__(self, filer_url: str):
+        self.filer_url = filer_url
+        self.store = MemoryStore()
+        self._visited = set()          # directories already listed
+        self._lock = threading.Lock()
+        self._sub_thread: Optional[threading.Thread] = None
+        self._sub_call = None
+        self._stopping = False
+
+    @property
+    def stub(self):
+        return filer_stub(self.filer_url)
+
+    # -- read-through ---------------------------------------------------------
+
+    def _ensure_dir(self, directory: str) -> None:
+        with self._lock:
+            if directory in self._visited:
+                return
+        try:
+            for r in self.stub.ListEntries(filer_pb2.ListEntriesRequest(
+                    directory=directory, limit=100000)):
+                self.store.insert_entry(directory, r.entry)
+        except grpc.RpcError:
+            pass
+        with self._lock:
+            self._visited.add(directory)
+
+    def find_entry(self, full_path: str) -> filer_pb2.Entry:
+        directory, name = split_path(full_path)
+        if not name:
+            return filer_pb2.Entry(name="/", is_directory=True)
+        self._ensure_dir(directory)
+        return self.store.find_entry(directory, name)
+
+    def list_entries(self, directory: str) -> List[filer_pb2.Entry]:
+        self._ensure_dir(directory)
+        return self.store.list_directory_entries(directory, limit=1 << 31)
+
+    # -- local mutation mirror ------------------------------------------------
+
+    def insert(self, directory: str, entry: filer_pb2.Entry) -> None:
+        self._ensure_dir(directory)
+        self.store.insert_entry(directory, entry)
+
+    def delete(self, directory: str, name: str) -> None:
+        self.store.delete_entry(directory, name)
+
+    # -- subscription invalidation -------------------------------------------
+
+    def start_subscription(self, since_ns: int = 0) -> None:
+        self._sub_thread = threading.Thread(
+            target=self._subscribe_loop, args=(since_ns,),
+            name="meta-cache-sub", daemon=True)
+        self._sub_thread.start()
+
+    def _subscribe_loop(self, since_ns: int) -> None:
+        while not self._stopping:
+            try:
+                self._sub_call = self.stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="mount", since_ns=since_ns))
+                for rec in self._sub_call:
+                    self._apply(rec)
+                    since_ns = max(since_ns, rec.ts_ns)
+                    if self._stopping:
+                        return
+            except grpc.RpcError:
+                if self._stopping:
+                    return
+                import time
+                time.sleep(0.2)
+
+    def _apply(self, rec: filer_pb2.SubscribeMetadataResponse) -> None:
+        ev = rec.event_notification
+        directory = rec.directory
+        if ev.old_entry.name and (
+                not ev.new_entry.name
+                or ev.new_entry.name != ev.old_entry.name
+                or ev.new_parent_path not in ("", directory)):
+            self.store.delete_entry(directory, ev.old_entry.name)
+        if ev.new_entry.name:
+            target_dir = ev.new_parent_path or directory
+            with self._lock:
+                known = target_dir in self._visited
+            if known:
+                self.store.insert_entry(target_dir, ev.new_entry)
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._sub_call is not None:
+            self._sub_call.cancel()

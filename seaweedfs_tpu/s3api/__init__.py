@@ -1,0 +1,4 @@
+"""S3-compatible gateway over the filer (reference: weed/s3api)."""
+
+from seaweedfs_tpu.s3api.server import S3ApiServer  # noqa: F401
+from seaweedfs_tpu.s3api.auth import Iam, Identity, Credential  # noqa: F401

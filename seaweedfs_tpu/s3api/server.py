@@ -1,0 +1,671 @@
+"""S3 REST gateway: buckets are directories under /buckets on the
+filer; object data rides the filer's auto-chunking HTTP path, metadata
+rides filer gRPC (reference: weed/s3api/s3api_server.go,
+s3api_object_handlers.go, filer_multipart.go).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.filer import http_client as filer_http
+from seaweedfs_tpu.pb import filer_pb2, filer_stub
+from seaweedfs_tpu.s3api.auth import (ACTION_LIST, ACTION_READ,
+                                      ACTION_TAGGING, ACTION_WRITE, Iam,
+                                      S3AuthError)
+
+BUCKETS_DIR = "/buckets"
+MULTIPART_DIR = ".uploads"          # hidden dir inside the bucket
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+TAG_PREFIX = "x-amz-tag-"
+
+
+class S3ApiServer:
+    def __init__(self, filer_url: str, ip: str = "127.0.0.1",
+                 port: int = 8333, iam: Optional[Iam] = None):
+        self.filer_url = filer_url
+        self.ip = ip
+        self.port = port
+        self.iam = iam or Iam()
+        self._http_server = None
+        self._http_thread = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> None:
+        self._http_server = ThreadingHTTPServer(
+            (self.ip, self.port), _make_handler(self))
+        self._http_thread = threading.Thread(
+            target=self._http_server.serve_forever,
+            name=f"s3-http-{self.port}", daemon=True)
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+            self._http_server.server_close()
+
+    # -- filer plumbing -------------------------------------------------------
+
+    @property
+    def stub(self):
+        return filer_stub(self.filer_url)
+
+    def filer_put(self, path: str, data: bytes,
+                  mime: str = "") -> Tuple[dict, dict]:
+        return filer_http.put(self.filer_url, path, data, mime)
+
+    def filer_get(self, path: str,
+                  range_header: Optional[str] = None) -> Tuple[int, bytes, dict]:
+        return filer_http.get(self.filer_url, path, range_header)
+
+    def find_entry(self, directory: str, name: str) -> Optional[filer_pb2.Entry]:
+        try:
+            return self.stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory, name=name)).entry
+        except grpc.RpcError:
+            return None
+
+    def list_entries(self, directory: str, prefix: str = "",
+                     start: str = "", inclusive: bool = False,
+                     limit: int = 10000) -> List[filer_pb2.Entry]:
+        try:
+            return [r.entry for r in self.stub.ListEntries(
+                filer_pb2.ListEntriesRequest(
+                    directory=directory, prefix=prefix,
+                    start_from_file_name=start,
+                    inclusive_start_from=inclusive, limit=limit))]
+        except grpc.RpcError:
+            return []
+
+
+# -- XML helpers --------------------------------------------------------------
+
+
+def _xml(tag: str, *children, text: Optional[str] = None, **attrs):
+    e = ET.Element(tag, attrs)
+    if text is not None:
+        e.text = text
+    for c in children:
+        e.append(c)
+    return e
+
+
+def _render(root: ET.Element) -> bytes:
+    root.set("xmlns", S3_NS)
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + \
+        ET.tostring(root)
+
+
+def _error_xml(code: str, message: str, resource: str) -> bytes:
+    return _render(_xml(
+        "Error",
+        _xml("Code", text=code),
+        _xml("Message", text=message),
+        _xml("Resource", text=resource)))
+
+
+# -- handler ------------------------------------------------------------------
+
+
+def _make_handler(s3: S3ApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        # -- plumbing ---------------------------------------------------------
+
+        def _reply(self, code: int, body: bytes = b"",
+                   headers: Optional[dict] = None,
+                   content_type: str = "application/xml") -> None:
+            self.send_response(code)
+            if body:
+                self.send_header("Content-Type", content_type)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD" and body:
+                self.wfile.write(body)
+
+        def _error(self, code: str, message: str, status: int) -> None:
+            self._reply(status, _error_xml(code, message, self.path))
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n) if n else b""
+
+        def _parse(self):
+            u = urllib.parse.urlparse(self.path)
+            path = urllib.parse.unquote(u.path)
+            parts = path.lstrip("/").split("/", 1)
+            bucket = parts[0] if parts[0] else ""
+            key = parts[1] if len(parts) > 1 else ""
+            return bucket, key, urllib.parse.parse_qs(
+                u.query, keep_blank_values=True), u.query
+
+        def _auth(self, action: str, bucket: str,
+                  payload: bytes = b"") -> None:
+            """Authorize the already-authenticated identity."""
+            if not self._ident.can_do(action, bucket):
+                raise S3AuthError("AccessDenied",
+                                  f"{self._ident.name} cannot {action} "
+                                  f"on {bucket}")
+
+        # -- dispatch ---------------------------------------------------------
+
+        def _route(self):
+            bucket, key, qs, raw_q = self._parse()
+            raw = self._body() if self.command in ("PUT", "POST") else b""
+            try:
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                u = urllib.parse.urlparse(self.path)
+                self._ident, payload = s3.iam.authenticate_and_decode(
+                    self.command, u.path, u.query, headers, raw)
+                if not bucket:
+                    self._auth(ACTION_LIST, "")
+                    return self._list_buckets()
+                if not key:
+                    return self._bucket_op(bucket, qs, payload)
+                return self._object_op(bucket, key, qs, payload)
+            except S3AuthError as e:
+                self._error(e.code, str(e), e.status)
+
+        do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = \
+            lambda self: self._route()
+
+        # -- service/bucket ---------------------------------------------------
+
+        def _list_buckets(self):
+            entries = s3.list_entries(BUCKETS_DIR)
+            buckets = _xml("Buckets")
+            for e in entries:
+                if e.is_directory:
+                    buckets.append(_xml(
+                        "Bucket",
+                        _xml("Name", text=e.name),
+                        _xml("CreationDate", text=_iso(e.attributes.crtime))))
+            root = _xml("ListAllMyBucketsResult",
+                        _xml("Owner", _xml("ID", text="seaweedfs")),
+                        buckets)
+            self._reply(200, _render(root))
+
+        def _bucket_op(self, bucket: str, qs, payload: bytes):
+            if self.command == "PUT":
+                self._auth(ACTION_ADMIN_OR_WRITE, bucket, payload)
+                s3.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                    directory=BUCKETS_DIR,
+                    entry=filer_pb2.Entry(name=bucket, is_directory=True)))
+                self._reply(200)
+            elif self.command == "DELETE":
+                self._auth(ACTION_ADMIN_OR_WRITE, bucket, payload)
+                s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=BUCKETS_DIR, name=bucket,
+                    is_delete_data=True, is_recursive=True,
+                    ignore_recursive_error=True))
+                self._reply(204)
+            elif self.command == "HEAD":
+                self._auth(ACTION_READ, bucket, payload)
+                if s3.find_entry(BUCKETS_DIR, bucket) is None:
+                    return self._error("NoSuchBucket", bucket, 404)
+                self._reply(200)
+            elif self.command == "POST" and "delete" in qs:
+                self._auth(ACTION_WRITE, bucket, payload)
+                self._batch_delete(bucket, payload)
+            elif self.command == "GET":
+                self._auth(ACTION_LIST, bucket, payload)
+                if s3.find_entry(BUCKETS_DIR, bucket) is None:
+                    return self._error("NoSuchBucket", bucket, 404)
+                if "uploads" in qs:
+                    return self._list_multipart_uploads(bucket)
+                self._list_objects(bucket, qs)
+            else:
+                self._error("MethodNotAllowed", self.command, 405)
+
+        # -- object -----------------------------------------------------------
+
+        def _object_op(self, bucket: str, key: str, qs, payload: bytes):
+            if "tagging" in qs:
+                return self._tagging_op(bucket, key, payload)
+            if self.command == "POST" and "uploads" in qs:
+                self._auth(ACTION_WRITE, bucket, payload)
+                return self._initiate_multipart(bucket, key)
+            if self.command == "PUT" and "uploadId" in qs:
+                self._auth(ACTION_WRITE, bucket, payload)
+                return self._upload_part(bucket, key, qs, payload)
+            if self.command == "POST" and "uploadId" in qs:
+                self._auth(ACTION_WRITE, bucket, payload)
+                return self._complete_multipart(bucket, key, qs, payload)
+            if self.command == "DELETE" and "uploadId" in qs:
+                self._auth(ACTION_WRITE, bucket, payload)
+                return self._abort_multipart(bucket, key, qs)
+            if self.command == "GET" and "uploadId" in qs:
+                self._auth(ACTION_READ, bucket, payload)
+                return self._list_parts(bucket, key, qs)
+
+            if self.command == "PUT":
+                self._auth(ACTION_WRITE, bucket, payload)
+                copy_src = self.headers.get("x-amz-copy-source")
+                if copy_src:
+                    return self._copy_object(bucket, key, copy_src)
+                return self._put_object(bucket, key, payload)
+            if self.command in ("GET", "HEAD"):
+                self._auth(ACTION_READ, bucket, payload)
+                return self._get_object(bucket, key)
+            if self.command == "DELETE":
+                self._auth(ACTION_WRITE, bucket, payload)
+                s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=_dir_of(bucket, key),
+                    name=_name_of(key), is_delete_data=True,
+                    is_recursive=True, ignore_recursive_error=True))
+                return self._reply(204)
+            self._error("MethodNotAllowed", self.command, 405)
+
+        def _put_object(self, bucket: str, key: str, payload: bytes):
+            mime = self.headers.get("Content-Type") or ""
+            _, resp_headers = s3.filer_put(
+                f"{BUCKETS_DIR}/{bucket}/{key}", payload, mime=mime)
+            # the filer's ETag header is the chunk-aware etag that
+            # HEAD/GET/list will also report; fall back to plain md5
+            etag = resp_headers.get("ETag") or \
+                hashlib.md5(payload).hexdigest()
+            self._reply(200, headers={"ETag": f'"{etag.strip(chr(34))}"'})
+
+        def _get_object(self, bucket: str, key: str):
+            entry = s3.find_entry(_dir_of(bucket, key), _name_of(key))
+            if entry is None or entry.is_directory:
+                return self._error("NoSuchKey", key, 404)
+            rng = self.headers.get("Range")
+            size = filechunks.total_size(entry.chunks)
+            if self.command == "HEAD":
+                return self._reply(200, headers={
+                    "Content-Length": str(size),
+                    "Content-Type": entry.attributes.mime or
+                    "application/octet-stream",
+                    "ETag": f'"{filechunks.etag_of_chunks(list(entry.chunks))}"'
+                    if entry.chunks else '""',
+                    "Last-Modified": _http_date(entry.attributes.mtime),
+                })
+            try:
+                status, data, headers = s3.filer_get(
+                    f"{BUCKETS_DIR}/{bucket}/{key}", rng)
+            except urllib.error.HTTPError as e:  # noqa: F821
+                return self._error("NoSuchKey", key, e.code)
+            out = {"Content-Type": entry.attributes.mime or
+                   "application/octet-stream"}
+            for h in ("Content-Range", "ETag"):
+                if h in headers:
+                    out[h] = headers[h]
+            self._reply(status, data, headers=out,
+                        content_type=out["Content-Type"])
+
+        def _copy_object(self, bucket: str, key: str, copy_src: str):
+            src = urllib.parse.unquote(copy_src).lstrip("/")
+            sbucket, _, skey = src.partition("/")
+            entry = s3.find_entry(_dir_of(sbucket, skey), _name_of(skey))
+            if entry is None:
+                return self._error("NoSuchKey", src, 404)
+            _, data, _ = s3.filer_get(f"{BUCKETS_DIR}/{sbucket}/{skey}")
+            s3.filer_put(f"{BUCKETS_DIR}/{bucket}/{key}", data,
+                         mime=entry.attributes.mime)
+            etag = hashlib.md5(data).hexdigest()
+            self._reply(200, _render(_xml(
+                "CopyObjectResult",
+                _xml("ETag", text=f'"{etag}"'),
+                _xml("LastModified", text=_iso(int(time.time()))))))
+
+        def _batch_delete(self, bucket: str, payload: bytes):
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError:
+                return self._error("MalformedXML", "bad delete body", 400)
+            deleted, quiet = [], False
+            q = root.find("{*}Quiet")
+            quiet = q is not None and (q.text or "").lower() == "true"
+            for obj in root.iter():
+                if not obj.tag.endswith("Object"):
+                    continue
+                k = obj.find("{*}Key")
+                if k is None or not k.text:
+                    continue
+                s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=_dir_of(bucket, k.text),
+                    name=_name_of(k.text), is_delete_data=True,
+                    is_recursive=True, ignore_recursive_error=True))
+                deleted.append(k.text)
+            result = _xml("DeleteResult")
+            if not quiet:
+                for k in deleted:
+                    result.append(_xml("Deleted", _xml("Key", text=k)))
+            self._reply(200, _render(result))
+
+        # -- listing ----------------------------------------------------------
+
+        def _list_objects(self, bucket: str, qs):
+            v2 = qs.get("list-type", [""])[0] == "2"
+            prefix = qs.get("prefix", [""])[0]
+            delimiter = qs.get("delimiter", [""])[0]
+            max_keys = min(int(qs.get("max-keys", ["1000"])[0] or 1000),
+                           1000)
+            if v2:
+                marker = urllib.parse.unquote(
+                    qs.get("continuation-token", [""])[0]) or \
+                    qs.get("start-after", [""])[0]
+            else:
+                marker = qs.get("marker", [""])[0]
+
+            contents, prefixes, truncated, next_marker = _walk_bucket(
+                s3, bucket, prefix, delimiter, marker, max_keys)
+
+            tag = "ListBucketResult"
+            root = _xml(tag,
+                        _xml("Name", text=bucket),
+                        _xml("Prefix", text=prefix),
+                        _xml("MaxKeys", text=str(max_keys)),
+                        _xml("IsTruncated",
+                             text="true" if truncated else "false"))
+            if delimiter:
+                root.append(_xml("Delimiter", text=delimiter))
+            for key, e in contents:
+                root.append(_xml(
+                    "Contents",
+                    _xml("Key", text=key),
+                    _xml("LastModified", text=_iso(e.attributes.mtime)),
+                    _xml("ETag",
+                         text=f'"{filechunks.etag_of_chunks(list(e.chunks))}"'
+                         if e.chunks else '""'),
+                    _xml("Size", text=str(
+                        filechunks.total_size(e.chunks))),
+                    _xml("StorageClass", text="STANDARD")))
+            for p in sorted(prefixes):
+                root.append(_xml("CommonPrefixes", _xml("Prefix", text=p)))
+            if truncated:
+                if v2:
+                    root.append(_xml("NextContinuationToken",
+                                     text=urllib.parse.quote(next_marker)))
+                else:
+                    root.append(_xml("NextMarker", text=next_marker))
+            if v2:
+                root.append(_xml("KeyCount", text=str(len(contents))))
+            self._reply(200, _render(root))
+
+        # -- multipart --------------------------------------------------------
+
+        def _initiate_multipart(self, bucket: str, key: str):
+            upload_id = secrets.token_hex(16)
+            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
+            entry = filer_pb2.Entry(name=upload_id, is_directory=True)
+            entry.extended["key"] = key.encode()
+            mime = self.headers.get("Content-Type") or ""
+            if mime:
+                entry.extended["mime"] = mime.encode()
+            s3.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
+                entry=entry))
+            self._reply(200, _render(_xml(
+                "InitiateMultipartUploadResult",
+                _xml("Bucket", text=bucket),
+                _xml("Key", text=key),
+                _xml("UploadId", text=upload_id))))
+
+        def _upload_part(self, bucket: str, key: str, qs, payload: bytes):
+            upload_id = qs.get("uploadId", [""])[0]
+            part = int(qs.get("partNumber", ["0"])[0])
+            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
+            if s3.find_entry(
+                    f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
+                    upload_id) is None:
+                return self._error("NoSuchUpload", upload_id, 404)
+            s3.filer_put(f"{updir}/{part:04d}.part", payload)
+            self._reply(200, headers={
+                "ETag": f'"{hashlib.md5(payload).hexdigest()}"'})
+
+        @staticmethod
+        def _manifest_part_numbers(payload: bytes) -> Optional[set]:
+            """Part numbers listed in the CompleteMultipartUpload body;
+            None when the body is absent/unparsable (assemble all, for
+            minimal clients)."""
+            if not payload:
+                return None
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError:
+                return None
+            nums = {int(e.text) for e in root.iter()
+                    if e.tag.endswith("PartNumber") and e.text}
+            return nums or None
+
+        def _complete_multipart(self, bucket: str, key: str, qs, payload):
+            upload_id = qs.get("uploadId", [""])[0]
+            mp_dir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}"
+            updir = f"{mp_dir}/{upload_id}"
+            meta = s3.find_entry(mp_dir, upload_id)
+            if meta is None:
+                return self._error("NoSuchUpload", upload_id, 404)
+            parts = [e for e in s3.list_entries(updir)
+                     if e.name.endswith(".part")]
+            # S3 assembles exactly the parts the client's manifest lists
+            wanted = self._manifest_part_numbers(payload)
+            if wanted is not None:
+                parts = [e for e in parts if int(e.name[:-5]) in wanted]
+            parts.sort(key=lambda e: e.name)
+            final = filer_pb2.Entry(name=_name_of(key))
+            mime = meta.extended.get("mime", b"").decode()
+            if mime:
+                final.attributes.mime = mime
+            offset = 0
+            for p in parts:
+                for c in p.chunks:
+                    nc = final.chunks.add()
+                    nc.CopyFrom(c)
+                    nc.offset = offset + c.offset
+                offset += filechunks.total_size(p.chunks)
+            now = int(time.time())
+            final.attributes.crtime = now
+            final.attributes.mtime = now
+            s3.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=_dir_of(bucket, key), entry=final))
+            # drop multipart scaffolding but keep the chunks (now owned
+            # by the final entry)
+            s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=mp_dir, name=upload_id,
+                is_delete_data=False, is_recursive=True,
+                ignore_recursive_error=True))
+            etag = filechunks.etag_of_chunks(list(final.chunks))
+            self._reply(200, _render(_xml(
+                "CompleteMultipartUploadResult",
+                _xml("Location",
+                     text=f"http://{s3.url}/{bucket}/{key}"),
+                _xml("Bucket", text=bucket),
+                _xml("Key", text=key),
+                _xml("ETag", text=f'"{etag}"'))))
+
+        def _abort_multipart(self, bucket: str, key: str, qs):
+            upload_id = qs.get("uploadId", [""])[0]
+            s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
+                name=upload_id, is_delete_data=True, is_recursive=True,
+                ignore_recursive_error=True))
+            self._reply(204)
+
+        def _list_parts(self, bucket: str, key: str, qs):
+            upload_id = qs.get("uploadId", [""])[0]
+            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
+            root = _xml("ListPartsResult",
+                        _xml("Bucket", text=bucket),
+                        _xml("Key", text=key),
+                        _xml("UploadId", text=upload_id))
+            for e in s3.list_entries(updir):
+                if not e.name.endswith(".part"):
+                    continue
+                root.append(_xml(
+                    "Part",
+                    _xml("PartNumber", text=str(int(e.name[:-5]))),
+                    _xml("LastModified", text=_iso(e.attributes.mtime)),
+                    _xml("Size",
+                         text=str(filechunks.total_size(e.chunks)))))
+            self._reply(200, _render(root))
+
+        def _list_multipart_uploads(self, bucket: str):
+            root = _xml("ListMultipartUploadsResult",
+                        _xml("Bucket", text=bucket))
+            for e in s3.list_entries(
+                    f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}"):
+                if e.is_directory:
+                    root.append(_xml(
+                        "Upload",
+                        _xml("Key",
+                             text=e.extended.get("key", b"").decode()),
+                        _xml("UploadId", text=e.name)))
+            self._reply(200, _render(root))
+
+        # -- tagging ----------------------------------------------------------
+
+        def _tagging_op(self, bucket: str, key: str, payload: bytes):
+            directory, name = _dir_of(bucket, key), _name_of(key)
+            entry = s3.find_entry(directory, name)
+            if entry is None:
+                self._auth(ACTION_TAGGING, bucket, payload)
+                return self._error("NoSuchKey", key, 404)
+            self._auth(ACTION_TAGGING, bucket, payload)
+            if self.command == "GET":
+                tagset = _xml("TagSet")
+                for k, v in entry.extended.items():
+                    if k.startswith(TAG_PREFIX):
+                        tagset.append(_xml(
+                            "Tag",
+                            _xml("Key", text=k[len(TAG_PREFIX):]),
+                            _xml("Value", text=v.decode())))
+                return self._reply(200, _render(_xml("Tagging", tagset)))
+            if self.command == "PUT":
+                try:
+                    root = ET.fromstring(payload)
+                except ET.ParseError:
+                    return self._error("MalformedXML", "bad tagging", 400)
+                for k in [k for k in entry.extended
+                          if k.startswith(TAG_PREFIX)]:
+                    del entry.extended[k]
+                for tag in root.iter():
+                    if tag.tag.endswith("Tag"):
+                        k = tag.find("{*}Key")
+                        v = tag.find("{*}Value")
+                        if k is not None and v is not None:
+                            entry.extended[TAG_PREFIX + (k.text or "")] = \
+                                (v.text or "").encode()
+                s3.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=directory, entry=entry))
+                return self._reply(200)
+            if self.command == "DELETE":
+                for k in [k for k in entry.extended
+                          if k.startswith(TAG_PREFIX)]:
+                    del entry.extended[k]
+                s3.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=directory, entry=entry))
+                return self._reply(204)
+            self._error("MethodNotAllowed", self.command, 405)
+
+    return Handler
+
+
+ACTION_ADMIN_OR_WRITE = ACTION_WRITE
+
+
+# -- helpers ------------------------------------------------------------------
+
+
+def _dir_of(bucket: str, key: str) -> str:
+    d = f"{BUCKETS_DIR}/{bucket}/{key}".rstrip("/")
+    return d.rsplit("/", 1)[0]
+
+
+def _name_of(key: str) -> str:
+    return key.rstrip("/").rsplit("/", 1)[-1]
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
+
+
+def _http_date(ts: int) -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts or 0))
+
+
+def _walk_bucket(s3: S3ApiServer, bucket: str, prefix: str,
+                 delimiter: str, marker: str, max_keys: int):
+    """Flatten the bucket directory tree into S3 keys in global
+    lexicographic order (the S3 contract — pagination markers compare
+    against ALL keys, not per-directory traversal order), then apply
+    delimiter grouping and marker/max-keys pagination."""
+    all_keys: List[tuple] = []
+    base = f"{BUCKETS_DIR}/{bucket}"
+
+    def recurse(directory: str, key_prefix: str):
+        for e in s3.list_entries(directory, limit=100000):
+            if key_prefix == "" and e.name == MULTIPART_DIR:
+                continue
+            key = key_prefix + e.name
+            if e.is_directory:
+                sub_prefix = key + "/"
+                # prune subtrees that cannot contain the prefix
+                if prefix and not sub_prefix.startswith(prefix) \
+                        and not prefix.startswith(sub_prefix):
+                    continue
+                recurse(f"{directory}/{e.name}", sub_prefix)
+            elif not prefix or key.startswith(prefix):
+                all_keys.append((key, e))
+
+    recurse(base, "")
+    all_keys.sort(key=lambda kv: kv[0])
+
+    contents: List[tuple] = []
+    prefixes: List[str] = []
+    seen_prefixes: set = set()
+    truncated = False
+    next_marker = ""
+    for key, e in all_keys:
+        if delimiter:
+            rest = key[len(prefix):]
+            if delimiter in rest:
+                cp = prefix + rest.split(delimiter)[0] + delimiter
+                if marker and cp <= marker:
+                    continue
+                if cp in seen_prefixes:
+                    continue
+                if len(contents) + len(prefixes) >= max_keys:
+                    truncated = True
+                    next_marker = cp
+                    break
+                seen_prefixes.add(cp)
+                prefixes.append(cp)
+                continue
+        if marker and key <= marker:
+            continue
+        if len(contents) + len(prefixes) >= max_keys:
+            truncated = True
+            next_marker = key
+            break
+        contents.append((key, e))
+    if truncated and not next_marker:
+        next_marker = contents[-1][0] if contents else ""
+    elif truncated:
+        # marker for the NEXT page is the last item actually returned
+        last_items = [c[0] for c in contents] + prefixes
+        next_marker = max(last_items) if last_items else next_marker
+    return contents, prefixes, truncated, next_marker

@@ -1,0 +1,147 @@
+"""Mount filesystem layer: dirty-page intervals (reference:
+weed/filesys/dirty_page_interval_test.go) and Wfs ops over a real
+cluster."""
+
+import pytest
+
+from seaweedfs_tpu.filesys import ContinuousIntervals, Wfs
+from seaweedfs_tpu.filesys.wfs import FuseError
+from tests.cluster_util import Cluster
+
+
+class TestContinuousIntervals:
+    def test_sequential_writes_merge(self):
+        ci = ContinuousIntervals()
+        ci.add_interval(b"aaa", 0)
+        ci.add_interval(b"bbb", 3)
+        assert len(ci.intervals) == 1
+        assert ci.read_data(0, 6) == b"aaabbb"
+
+    def test_overwrite_shadows(self):
+        ci = ContinuousIntervals()
+        ci.add_interval(b"xxxxxxxxxx", 0)
+        ci.add_interval(b"YY", 4)
+        assert ci.read_data(0, 10) == b"xxxxYYxxxx"
+
+    def test_random_order_writes(self):
+        ci = ContinuousIntervals()
+        ci.add_interval(b"cc", 4)
+        ci.add_interval(b"aa", 0)
+        assert ci.read_data(0, 6) == b"aa\x00\x00cc"
+        ci.add_interval(b"bb", 2)
+        assert ci.read_data(0, 6) == b"aabbcc"
+        assert len(ci.intervals) == 1  # fully merged
+
+    def test_read_over_base(self):
+        ci = ContinuousIntervals()
+        ci.add_interval(b"NEW", 2)
+        assert ci.read_data(0, 8, base=b"olddataX") == b"olNEWtaX"
+
+    def test_total_size_and_pop(self):
+        ci = ContinuousIntervals()
+        ci.add_interval(b"abc", 10)
+        assert ci.total_size == 13
+        popped = ci.pop_all()
+        assert [(iv.offset, iv.data) for iv in popped] == [(10, b"abc")]
+        assert not ci
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("wfs_cluster"),
+                n_volume_servers=1, with_filer=True)
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def wfs(cluster):
+    w = Wfs(filer_url=cluster.filer.url)
+    yield w
+    w.stop()
+
+
+class TestWfs:
+    def test_create_write_read_cycle(self, wfs):
+        fh = wfs.create("/w/f.txt")
+        wfs.write(fh, b"hello ", 0)
+        wfs.write(fh, b"world", 6)
+        # read-before-flush sees dirty pages
+        assert wfs.read(fh, 0, 100) == b"hello world"
+        wfs.flush(fh)
+        wfs.release(fh)
+        # fresh handle reads flushed chunks
+        fh2 = wfs.open("/w/f.txt")
+        assert wfs.read(fh2, 0, 100) == b"hello world"
+        assert wfs.read(fh2, 6, 5) == b"world"
+        wfs.release(fh2)
+
+    def test_overwrite_after_flush(self, wfs):
+        fh = wfs.create("/w/ow.txt")
+        wfs.write(fh, b"0123456789", 0)
+        wfs.flush(fh)
+        wfs.write(fh, b"XX", 4)
+        assert wfs.read(fh, 0, 10) == b"0123XX6789"
+        wfs.flush(fh)
+        wfs.release(fh)
+        fh2 = wfs.open("/w/ow.txt")
+        assert wfs.read(fh2, 0, 10) == b"0123XX6789"
+        wfs.release(fh2)
+
+    def test_mkdir_readdir_unlink(self, wfs):
+        wfs.mkdir("/w/dir1")
+        fh = wfs.create("/w/dir1/a.txt")
+        wfs.write(fh, b"a", 0)
+        wfs.release(fh)
+        names = sorted(e.name for e in wfs.readdir("/w/dir1"))
+        assert names == ["a.txt"]
+        wfs.unlink("/w/dir1/a.txt")
+        assert wfs.readdir("/w/dir1") == []
+        with pytest.raises(FuseError):
+            wfs.getattr("/w/dir1/a.txt")
+
+    def test_rename(self, wfs):
+        fh = wfs.create("/w/old.txt")
+        wfs.write(fh, b"data", 0)
+        wfs.release(fh)
+        wfs.rename("/w/old.txt", "/w/new.txt")
+        fh2 = wfs.open("/w/new.txt")
+        assert wfs.read(fh2, 0, 4) == b"data"
+        wfs.release(fh2)
+        with pytest.raises(FuseError):
+            wfs.open("/w/old.txt")
+
+    def test_open_missing_enoent(self, wfs):
+        with pytest.raises(FuseError):
+            wfs.open("/w/ghost.txt")
+
+    def test_meta_cache_invalidation_from_other_client(self, cluster, wfs):
+        # warm the cache
+        wfs.mkdir("/w/shared")
+        assert wfs.readdir("/w/shared") == []
+        # another client (the filer HTTP API) adds a file
+        cluster.http(f"http://{cluster.filer.url}/w/shared/ext.txt",
+                     data=b"external", method="POST").close()
+        cluster.wait_for(
+            lambda: any(e.name == "ext.txt"
+                        for e in wfs.readdir("/w/shared")),
+            what="subscription invalidates meta cache")
+
+
+def test_rmdir_refuses_non_empty(wfs):
+    """Regression: rmdir used to recursively destroy directory
+    contents; POSIX demands ENOTEMPTY."""
+    wfs.mkdir("/w/full")
+    fh = wfs.create("/w/full/keep.txt")
+    wfs.write(fh, b"precious", 0)
+    wfs.release(fh)
+    with pytest.raises(FuseError) as ei:
+        wfs.rmdir("/w/full")
+    assert ei.value.errno == 39
+    fh2 = wfs.open("/w/full/keep.txt")
+    assert wfs.read(fh2, 0, 100) == b"precious"
+    wfs.release(fh2)
+    wfs.unlink("/w/full/keep.txt")
+    wfs.rmdir("/w/full")  # empty now: succeeds
+    with pytest.raises(FuseError):
+        wfs.getattr("/w/full")

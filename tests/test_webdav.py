@@ -1,0 +1,135 @@
+"""WebDAV gateway end-to-end (reference: weed/server/webdav_server.go
+behavior via golang.org/x/net/webdav's verb set)."""
+
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.server.webdav import WebDavServer
+from tests.cluster_util import Cluster, free_port_pair
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("dav_cluster"),
+                n_volume_servers=1, with_filer=True)
+    c.dav = WebDavServer(filer_url=c.filer.url, port=free_port_pair())
+    c.dav.start()
+    yield c
+    c.dav.stop()
+    c.stop()
+
+
+def dav_req(cluster, method, path, data=None, **headers):
+    req = urllib.request.Request(
+        f"http://{cluster.dav.url}{path}", data=data, method=method,
+        headers=headers)
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_options_advertises_dav(cluster):
+    with dav_req(cluster, "OPTIONS", "/") as r:
+        assert "1,2" in r.headers["DAV"]
+        assert "PROPFIND" in r.headers["Allow"]
+
+
+def test_mkcol_put_get_cycle(cluster):
+    with dav_req(cluster, "MKCOL", "/docs") as r:
+        assert r.status == 201
+    with dav_req(cluster, "PUT", "/docs/report.txt",
+                 data=b"dav content") as r:
+        assert r.status == 201
+    with dav_req(cluster, "GET", "/docs/report.txt") as r:
+        assert r.read() == b"dav content"
+
+
+def test_propfind_depth1_lists_children(cluster):
+    with dav_req(cluster, "MKCOL", "/pf"):
+        pass
+    with dav_req(cluster, "PUT", "/pf/a.txt", data=b"aaaa"):
+        pass
+    with dav_req(cluster, "PROPFIND", "/pf", Depth="1") as r:
+        assert r.status == 207
+        body = r.read()
+    root = ET.fromstring(body)
+    hrefs = [e.text for e in root.iter("{DAV:}href")]
+    assert "/pf" in hrefs[0]
+    assert any(h.endswith("/pf/a.txt") for h in hrefs)
+    sizes = [e.text for e in root.iter("{DAV:}getcontentlength")]
+    assert "4" in sizes
+    # depth 0: only the collection itself
+    with dav_req(cluster, "PROPFIND", "/pf", Depth="0") as r:
+        root0 = ET.fromstring(r.read())
+    assert len(list(root0.iter("{DAV:}response"))) == 1
+
+
+def test_propfind_404(cluster):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_req(cluster, "PROPFIND", "/ghost", Depth="0")
+    assert ei.value.code == 404
+
+
+def test_move(cluster):
+    with dav_req(cluster, "PUT", "/mv-src.txt", data=b"move me"):
+        pass
+    with dav_req(cluster, "MOVE", "/mv-src.txt",
+                 Destination=f"http://{cluster.dav.url}/mv-dst.txt") as r:
+        assert r.status == 201
+    with dav_req(cluster, "GET", "/mv-dst.txt") as r:
+        assert r.read() == b"move me"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_req(cluster, "GET", "/mv-src.txt")
+    assert ei.value.code == 404
+
+
+def test_move_no_overwrite(cluster):
+    with dav_req(cluster, "PUT", "/now-a.txt", data=b"a"):
+        pass
+    with dav_req(cluster, "PUT", "/now-b.txt", data=b"b"):
+        pass
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        dav_req(cluster, "MOVE", "/now-a.txt",
+                Destination=f"http://{cluster.dav.url}/now-b.txt",
+                Overwrite="F")
+    assert ei.value.code == 412
+
+
+def test_copy(cluster):
+    with dav_req(cluster, "PUT", "/cp-src.txt", data=b"copy me"):
+        pass
+    with dav_req(cluster, "COPY", "/cp-src.txt",
+                 Destination=f"http://{cluster.dav.url}/cp-dst.txt") as r:
+        assert r.status == 201
+    with dav_req(cluster, "GET", "/cp-src.txt") as r:
+        assert r.read() == b"copy me"
+    with dav_req(cluster, "GET", "/cp-dst.txt") as r:
+        assert r.read() == b"copy me"
+
+
+def test_delete_collection(cluster):
+    with dav_req(cluster, "MKCOL", "/rmdir"):
+        pass
+    with dav_req(cluster, "PUT", "/rmdir/f.txt", data=b"x"):
+        pass
+    with dav_req(cluster, "DELETE", "/rmdir") as r:
+        assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError):
+        dav_req(cluster, "GET", "/rmdir/f.txt")
+
+
+def test_lock_unlock_fake(cluster):
+    with dav_req(cluster, "LOCK", "/locked.txt") as r:
+        assert "opaquelocktoken" in r.headers["Lock-Token"]
+        assert b"lockdiscovery" in r.read()
+    with dav_req(cluster, "UNLOCK", "/locked.txt") as r:
+        assert r.status == 204
+
+
+def test_range_read(cluster):
+    with dav_req(cluster, "PUT", "/rng.bin", data=bytes(range(100))):
+        pass
+    with dav_req(cluster, "GET", "/rng.bin", Range="bytes=10-19") as r:
+        assert r.status == 206
+        assert r.read() == bytes(range(10, 20))
