@@ -63,6 +63,9 @@ class Filer:
         # (wired to operation.delete_files by the filer server)
         self.on_delete_chunks: Callable[[List[filer_pb2.FileChunk]], None] = \
             lambda chunks: None
+        # optional external queue: every event also published there
+        # (reference filer.notify → weed/notification)
+        self.notification_queue = None
 
     # -- event log ------------------------------------------------------------
 
@@ -70,8 +73,11 @@ class Filer:
                 old: Optional[filer_pb2.Entry],
                 new: Optional[filer_pb2.Entry],
                 delete_chunks: bool = False,
-                new_parent_path: str = "") -> None:
-        ev = filer_pb2.EventNotification(delete_chunks=delete_chunks)
+                new_parent_path: str = "",
+                from_other_cluster: bool = False) -> None:
+        ev = filer_pb2.EventNotification(
+            delete_chunks=delete_chunks,
+            is_from_other_cluster=from_other_cluster)
         if old is not None:
             ev.old_entry.CopyFrom(old)
         if new is not None:
@@ -79,13 +85,21 @@ class Filer:
         if new_parent_path:
             ev.new_parent_path = new_parent_path
         self.meta_log.append_event(directory, ev)
+        if self.notification_queue is not None:
+            try:
+                self.notification_queue.send_message(directory, ev)
+            except Exception:
+                # the write already committed; a broken external queue
+                # must not turn it into a client-visible failure
+                pass
 
     # -- CRUD -----------------------------------------------------------------
 
     def create_entry(self, directory: str, entry: filer_pb2.Entry,
-                     o_excl: bool = False) -> None:
+                     o_excl: bool = False,
+                     from_other_cluster: bool = False) -> None:
         directory = normalize_path(directory)
-        self._ensure_parents(directory)
+        self._ensure_parents(directory, from_other_cluster)
         old = None
         try:
             old = self.store.find_entry(directory, entry.name)
@@ -103,14 +117,16 @@ class Filer:
         if not entry.attributes.mtime:
             entry.attributes.mtime = _now()
         self.store.insert_entry(directory, entry)
-        self._notify(directory, old, entry)
+        self._notify(directory, old, entry,
+                     from_other_cluster=from_other_cluster)
         if old is not None and not old.is_directory:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
             if unused:
                 self.on_delete_chunks(unused)
 
-    def _ensure_parents(self, directory: str) -> None:
+    def _ensure_parents(self, directory: str,
+                        from_other_cluster: bool = False) -> None:
         if directory == "/":
             return
         parent, name = split_path(directory)
@@ -121,10 +137,11 @@ class Filer:
             return
         except NotFound:
             pass
-        self._ensure_parents(parent)
+        self._ensure_parents(parent, from_other_cluster)
         d = new_entry(name, is_directory=True)
         self.store.insert_entry(parent, d)
-        self._notify(parent, None, d)
+        self._notify(parent, None, d,
+                     from_other_cluster=from_other_cluster)
 
     def find_entry(self, full_path: str) -> filer_pb2.Entry:
         directory, name = split_path(full_path)
@@ -139,7 +156,8 @@ class Filer:
             raise NotFound(full_path)
         return e
 
-    def update_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+    def update_entry(self, directory: str, entry: filer_pb2.Entry,
+                     from_other_cluster: bool = False) -> None:
         directory = normalize_path(directory)
         old = None
         try:
@@ -147,7 +165,8 @@ class Filer:
         except NotFound:
             pass
         self.store.update_entry(directory, entry)
-        self._notify(directory, old, entry)
+        self._notify(directory, old, entry,
+                     from_other_cluster=from_other_cluster)
         if old is not None and not old.is_directory:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
@@ -190,7 +209,8 @@ class Filer:
 
     def delete_entry(self, full_path: str, recursive: bool = False,
                      ignore_recursive_error: bool = False,
-                     delete_data: bool = True) -> None:
+                     delete_data: bool = True,
+                     from_other_cluster: bool = False) -> None:
         directory, name = split_path(full_path)
         try:
             entry = self.store.find_entry(directory, name)
@@ -204,7 +224,8 @@ class Filer:
             self.store.delete_folder_children(join_path(directory, name))
         chunks.extend(entry.chunks)
         self.store.delete_entry(directory, name)
-        self._notify(directory, entry, None, delete_chunks=delete_data)
+        self._notify(directory, entry, None, delete_chunks=delete_data,
+                     from_other_cluster=from_other_cluster)
         if delete_data and chunks:
             self.on_delete_chunks(chunks)
 

@@ -1,0 +1,4 @@
+"""Pub/sub message broker (reference: weed/messaging)."""
+
+from seaweedfs_tpu.messaging.broker import MessageBroker  # noqa: F401
+from seaweedfs_tpu.messaging.client import MessagingClient  # noqa: F401

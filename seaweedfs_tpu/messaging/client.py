@@ -1,0 +1,102 @@
+"""Messaging client: publisher + subscriber over the broker's bidi
+streams (reference: weed/messaging/msgclient)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from seaweedfs_tpu.pb import messaging_pb2, messaging_stub
+
+
+class Publisher:
+    def __init__(self, broker_url: str, namespace: str, topic: str,
+                 partition: int = -1):
+        self.stub = messaging_stub(broker_url)
+        self._q: "queue.Queue" = queue.Queue()
+        self._q.put(messaging_pb2.PublishRequest(
+            init=messaging_pb2.PublishRequest.InitMessage(
+                namespace=namespace, topic=topic, partition=partition)))
+        self._call = self.stub.Publish(self._request_iter())
+        self._responses = iter(self._call)
+        first = next(self._responses)  # config message
+        self.partition_count = first.config.partition_count
+
+    def _request_iter(self) -> Iterator:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+    def publish(self, value: bytes, key: bytes = b"",
+                headers: Optional[dict] = None) -> None:
+        msg = messaging_pb2.Message(
+            event_time_ns=time.time_ns(), key=key, value=value)
+        for k, v in (headers or {}).items():
+            msg.headers[k] = v
+        self._q.put(messaging_pb2.PublishRequest(data=msg))
+        next(self._responses)  # per-message ack
+
+    def close(self) -> None:
+        self._q.put(messaging_pb2.PublishRequest(
+            data=messaging_pb2.Message(is_close=True)))
+        try:
+            next(self._responses)
+        except StopIteration:
+            pass
+        self._q.put(None)
+
+
+class Subscriber:
+    def __init__(self, broker_url: str, namespace: str, topic: str,
+                 partition: int = 0, start: str = "latest",
+                 since_ns: int = 0, subscriber_id: str = ""):
+        Start = messaging_pb2.SubscriberMessage.InitMessage
+        pos = {"latest": Start.LATEST, "earliest": Start.EARLIEST,
+               "timestamp": Start.TIMESTAMP}[start]
+        init = messaging_pb2.SubscriberMessage(
+            init=Start(namespace=namespace, topic=topic,
+                       partition=partition, startPosition=pos,
+                       timestampNs=since_ns,
+                       subscriber_id=subscriber_id))
+        self._call = messaging_stub(broker_url).Subscribe(iter([init]))
+
+    def __iter__(self) -> Iterator[messaging_pb2.Message]:
+        for resp in self._call:
+            if resp.data.is_close:
+                return
+            yield resp.data
+
+    def cancel(self) -> None:
+        self._call.cancel()
+
+
+class MessagingClient:
+    def __init__(self, broker_url: str):
+        self.broker_url = broker_url
+
+    def new_publisher(self, namespace: str, topic: str,
+                      partition: int = -1) -> Publisher:
+        return Publisher(self.broker_url, namespace, topic, partition)
+
+    def new_subscriber(self, namespace: str, topic: str,
+                       partition: int = 0, start: str = "latest",
+                       since_ns: int = 0) -> Subscriber:
+        return Subscriber(self.broker_url, namespace, topic, partition,
+                          start, since_ns)
+
+    def configure_topic(self, namespace: str, topic: str,
+                        partition_count: int) -> None:
+        messaging_stub(self.broker_url).ConfigureTopic(
+            messaging_pb2.ConfigureTopicRequest(
+                namespace=namespace, topic=topic,
+                configuration=messaging_pb2.TopicConfiguration(
+                    partition_count=partition_count)))
+
+    def delete_topic(self, namespace: str, topic: str) -> None:
+        messaging_stub(self.broker_url).DeleteTopic(
+            messaging_pb2.DeleteTopicRequest(
+                namespace=namespace, topic=topic))

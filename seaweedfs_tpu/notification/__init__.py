@@ -1,0 +1,89 @@
+"""Notification queues: publish filer events for external consumers
+(reference: weed/notification — log/Kafka/SQS/PubSub backends behind
+one interface; here: memory + file-log backends, with a registry for
+environments that provide richer brokers)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.util.log_buffer import LogEntry
+
+
+class MessageQueue:
+    """SPI: send_message(key, EventNotification)."""
+
+    def send_message(self, key: str,
+                     event: filer_pb2.EventNotification) -> None:
+        raise NotImplementedError
+
+
+class MemoryQueue(MessageQueue):
+    """In-process queue with subscriber callbacks (test/dev backend)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.messages: List[Tuple[str, filer_pb2.EventNotification]] = []
+        self._subscribers: List[Callable] = []
+
+    def send_message(self, key, event):
+        with self._lock:
+            self.messages.append((key, event))
+            subs = list(self._subscribers)
+        for fn in subs:
+            fn(key, event)
+
+    def subscribe(self, fn: Callable) -> None:
+        with self._lock:
+            self._subscribers.append(fn)
+
+
+class LogQueue(MessageQueue):
+    """Append events to a local log file with the shared length-prefixed
+    framing (reference notification/log — a debugging sink)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+
+    def send_message(self, key, event):
+        rec = filer_pb2.SubscribeMetadataResponse(
+            directory=key, event_notification=event)
+        blob = LogEntry(0, 0, rec.SerializeToString()).pack()
+        with self._lock, open(self.path, "ab") as f:
+            f.write(blob)
+
+    def read_all(self) -> List[Tuple[str, filer_pb2.EventNotification]]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path, "rb") as f:
+            blob = f.read()
+        out = []
+        for e in LogEntry.unpack_stream(blob):
+            rec = filer_pb2.SubscribeMetadataResponse()
+            rec.ParseFromString(e.data)
+            out.append((rec.directory, rec.event_notification))
+        return out
+
+
+_REGISTRY: Dict[str, Callable[..., MessageQueue]] = {
+    "memory": MemoryQueue,
+    "log": LogQueue,
+}
+
+
+def register(name: str, factory: Callable[..., MessageQueue]) -> None:
+    _REGISTRY[name] = factory
+
+
+def new_queue(name: str, **kwargs) -> MessageQueue:
+    factory = _REGISTRY.get(name)
+    if factory is None:
+        raise ValueError(
+            f"notification backend {name!r} not available in this "
+            f"image; registered: {sorted(_REGISTRY)}")
+    return factory(**kwargs)

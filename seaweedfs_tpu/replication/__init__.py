@@ -1,0 +1,7 @@
+"""Replication of filer events to sinks (reference: weed/replication)."""
+
+from seaweedfs_tpu.replication.replicator import Replicator  # noqa: F401
+from seaweedfs_tpu.replication.sinks import (  # noqa: F401
+    FilerSink, LocalSink, ReplicationSink,
+)
+from seaweedfs_tpu.replication.source import FilerSource  # noqa: F401

@@ -1,0 +1,85 @@
+"""Active-active metadata sync between two filer clusters
+(reference: weed/command/filer_sync.go — tail each cluster's event log
+and replay on the other; is_from_other_cluster marks replayed events
+so they are not bounced back, the signature-loop-prevention analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import grpc
+
+from seaweedfs_tpu.pb import filer_pb2, filer_stub
+from seaweedfs_tpu.replication.replicator import Replicator
+from seaweedfs_tpu.replication.sinks import FilerSink
+from seaweedfs_tpu.replication.source import FilerSource
+
+
+class _OneWay:
+    def __init__(self, src_url: str, dst_url: str, path_prefix: str):
+        self.src_url = src_url
+        self.replicator = Replicator(
+            FilerSource(src_url), FilerSink(dst_url),
+            path_filter=path_prefix)
+        self.path_prefix = path_prefix
+        self._stopping = False
+        self._call = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, since_ns: int) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, args=(since_ns,),
+            name=f"filer-sync-{self.src_url}", daemon=True)
+        self._thread.start()
+
+    def _loop(self, since_ns: int) -> None:
+        while not self._stopping:
+            try:
+                self._call = filer_stub(self.src_url).SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="filer.sync",
+                        path_prefix=self.path_prefix,
+                        since_ns=since_ns))
+                for rec in self._call:
+                    if self._stopping:
+                        return
+                    since_ns = max(since_ns, rec.ts_ns)
+                    ev = rec.event_notification
+                    if ev.is_from_other_cluster:
+                        continue  # our own replay echoing back
+                    try:
+                        self.replicator.replicate(rec.directory, ev)
+                    except Exception:
+                        # one unreplayable event (e.g. source chunk
+                        # already deleted) must not kill the tail
+                        continue
+            except Exception:
+                if self._stopping:
+                    return
+                time.sleep(0.2)
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._call is not None:
+            self._call.cancel()
+
+
+class FilerSync:
+    """Bidirectional: A→B and B→A tails running concurrently."""
+
+    def __init__(self, filer_a: str, filer_b: str,
+                 path_prefix: str = "/"):
+        self.a_to_b = _OneWay(filer_a, filer_b, path_prefix)
+        self.b_to_a = _OneWay(filer_b, filer_a, path_prefix)
+
+    def start(self, since_ns: Optional[int] = None) -> None:
+        ts = time.time_ns() if since_ns is None else since_ns
+        self.a_to_b.start(ts)
+        self.b_to_a.start(ts)
+
+    def stop(self) -> None:
+        self.a_to_b.stop()
+        self.b_to_a.stop()

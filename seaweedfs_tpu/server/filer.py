@@ -183,14 +183,17 @@ class FilerServer:
 
     def CreateEntry(self, request, context):
         try:
-            self.filer.create_entry(request.directory, request.entry,
-                                    o_excl=request.o_excl)
+            self.filer.create_entry(
+                request.directory, request.entry, o_excl=request.o_excl,
+                from_other_cluster=request.is_from_other_cluster)
             return filer_pb2.CreateEntryResponse()
         except FilerError as e:
             return filer_pb2.CreateEntryResponse(error=str(e))
 
     def UpdateEntry(self, request, context):
-        self.filer.update_entry(request.directory, request.entry)
+        self.filer.update_entry(
+            request.directory, request.entry,
+            from_other_cluster=request.is_from_other_cluster)
         return filer_pb2.UpdateEntryResponse()
 
     def AppendToEntry(self, request, context):
@@ -205,7 +208,8 @@ class FilerServer:
                 join_path(request.directory, request.name),
                 recursive=request.is_recursive,
                 ignore_recursive_error=request.ignore_recursive_error,
-                delete_data=request.is_delete_data)
+                delete_data=request.is_delete_data,
+                from_other_cluster=request.is_from_other_cluster)
             return filer_pb2.DeleteEntryResponse()
         except FilerError as e:
             return filer_pb2.DeleteEntryResponse(error=str(e))

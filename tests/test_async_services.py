@@ -1,0 +1,226 @@
+"""Async services: replication sinks, notification queues, filer.sync,
+message broker (reference: weed/replication, weed/notification,
+weed/command/filer_sync.go, weed/messaging)."""
+
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.messaging import MessageBroker, MessagingClient
+from seaweedfs_tpu.notification import LogQueue, MemoryQueue, new_queue
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.replication import (FilerSink, LocalSink, Replicator,
+                                       FilerSource)
+from seaweedfs_tpu.replication.filer_sync import FilerSync
+from tests.cluster_util import Cluster, free_port_pair
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("async_cluster"),
+                n_volume_servers=1, with_filer=True)
+    yield c
+    c.stop()
+
+
+def _post(cluster, filer, path, data):
+    return cluster.http(f"http://{filer.url}{path}", data=data,
+                        method="POST")
+
+
+class TestNotification:
+    def test_memory_queue_receives_filer_events(self, cluster):
+        q = MemoryQueue()
+        cluster.filer.filer.notification_queue = q
+        try:
+            _post(cluster, cluster.filer, "/nq/f.txt", b"x").close()
+            assert any(ev.new_entry.name == "f.txt"
+                       for _, ev in q.messages)
+        finally:
+            cluster.filer.filer.notification_queue = None
+
+    def test_log_queue_round_trip(self, tmp_path):
+        q = LogQueue(str(tmp_path / "events.log"))
+        ev = filer_pb2.EventNotification()
+        ev.new_entry.name = "logged.txt"
+        q.send_message("/dir", ev)
+        got = q.read_all()
+        assert len(got) == 1
+        assert got[0][0] == "/dir"
+        assert got[0][1].new_entry.name == "logged.txt"
+
+    def test_registry(self, tmp_path):
+        assert isinstance(new_queue("memory"), MemoryQueue)
+        assert isinstance(
+            new_queue("log", path=str(tmp_path / "l.log")), LogQueue)
+        with pytest.raises(ValueError):
+            new_queue("kafka")
+
+
+class TestReplicationSinks:
+    def test_local_sink_full_cycle(self, cluster, tmp_path):
+        sink = LocalSink(str(tmp_path / "mirror"))
+        repl = Replicator(FilerSource(cluster.filer.url), sink)
+        q = MemoryQueue()
+        q.subscribe(repl.replicate)
+        cluster.filer.filer.notification_queue = q
+        try:
+            _post(cluster, cluster.filer, "/repl/a.txt",
+                  b"replicated bytes").close()
+            target = tmp_path / "mirror" / "repl" / "a.txt"
+            assert target.read_bytes() == b"replicated bytes"
+            # delete propagates
+            cluster.http(f"http://{cluster.filer.url}/repl/a.txt",
+                         method="DELETE").close()
+            assert not target.exists()
+        finally:
+            cluster.filer.filer.notification_queue = None
+
+    def test_filer_sink_replicates_to_second_cluster(
+            self, cluster, tmp_path_factory):
+        c2 = Cluster(tmp_path_factory.mktemp("repl_dst"),
+                     n_volume_servers=1, with_filer=True)
+        try:
+            repl = Replicator(FilerSource(cluster.filer.url),
+                              FilerSink(c2.filer.url))
+            q = MemoryQueue()
+            q.subscribe(repl.replicate)
+            cluster.filer.filer.notification_queue = q
+            _post(cluster, cluster.filer, "/xr/data.bin",
+                  b"cross cluster").close()
+            with c2.http(f"http://{c2.filer.url}/xr/data.bin") as r:
+                assert r.read() == b"cross cluster"
+        finally:
+            cluster.filer.filer.notification_queue = None
+            c2.stop()
+
+
+class TestFilerSync:
+    def test_active_active_no_ping_pong(self, cluster, tmp_path_factory):
+        c2 = Cluster(tmp_path_factory.mktemp("sync_b"),
+                     n_volume_servers=1, with_filer=True)
+        sync = FilerSync(cluster.filer.url, c2.filer.url)
+        sync.start()
+        try:
+            # A -> B
+            _post(cluster, cluster.filer, "/sync/from-a.txt",
+                  b"written on A").close()
+            c2.wait_for(
+                lambda: _exists(c2, "/sync/from-a.txt"),
+                what="A->B sync")
+            with c2.http(
+                    f"http://{c2.filer.url}/sync/from-a.txt") as r:
+                assert r.read() == b"written on A"
+            # B -> A
+            _post(c2, c2.filer, "/sync/from-b.txt",
+                  b"written on B").close()
+            cluster.wait_for(
+                lambda: _exists(cluster, "/sync/from-b.txt"),
+                what="B->A sync")
+            # loop prevention: event counts settle (no infinite bounce)
+            time.sleep(1.0)
+            n_a = len(cluster.filer.filer.meta_log.read_events_since(0))
+            n_b = len(c2.filer.filer.meta_log.read_events_since(0))
+            time.sleep(1.0)
+            assert len(cluster.filer.filer.meta_log
+                       .read_events_since(0)) == n_a
+            assert len(c2.filer.filer.meta_log.read_events_since(0)) == n_b
+        finally:
+            sync.stop()
+            c2.stop()
+
+
+def _exists(c, path):
+    import urllib.error
+    try:
+        c.http(f"http://{c.filer.url}{path}").close()
+        return True
+    except urllib.error.HTTPError:
+        return False
+
+
+class TestMessageBroker:
+    @pytest.fixture(scope="class")
+    def broker(self, cluster):
+        b = MessageBroker(filer_url=cluster.filer.url,
+                          port=free_port_pair())
+        b.start()
+        yield b
+        b.stop()
+
+    def test_publish_subscribe_latest(self, broker):
+        client = MessagingClient(broker.url)
+        got = []
+        done = threading.Event()
+        sub = client.new_subscriber("ns", "chat", partition=1,
+                                    start="earliest")
+
+        def consume():
+            for msg in sub:
+                got.append(msg.value)
+                if len(got) == 3:
+                    done.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        pub = client.new_publisher("ns", "chat", partition=1)
+        assert pub.partition_count == 4
+        for i in range(3):
+            pub.publish(f"msg-{i}".encode(), key=b"k")
+        pub.close()
+        assert done.wait(10), f"only got {got}"
+        assert got == [b"msg-0", b"msg-1", b"msg-2"]
+        sub.cancel()
+
+    def test_key_hash_partitioning_stable(self, broker):
+        client = MessagingClient(broker.url)
+        pub = client.new_publisher("ns", "parts")  # no fixed partition
+        for _ in range(5):
+            pub.publish(b"v", key=b"same-key")
+        pub.close()
+        t = broker._get_topic("ns", "parts")
+        holding = [len(p.entries) for p in t.partitions]
+        assert sum(holding) == 5
+        assert max(holding) == 5  # same key -> same partition
+
+    def test_earliest_replay_after_restart(self, cluster, broker):
+        client = MessagingClient(broker.url)
+        pub = client.new_publisher("ns", "durable", partition=0)
+        pub.publish(b"persisted-1")
+        pub.publish(b"persisted-2")
+        pub.close()
+        # a NEW broker instance on the same filer restores the log
+        b2 = MessageBroker(filer_url=cluster.filer.url,
+                           port=free_port_pair())
+        b2.start()
+        try:
+            sub = MessagingClient(b2.url).new_subscriber(
+                "ns", "durable", partition=0, start="earliest")
+            got = []
+            for msg in sub:
+                got.append(msg.value)
+                if len(got) == 2:
+                    break
+            sub.cancel()
+            assert got == [b"persisted-1", b"persisted-2"]
+        finally:
+            b2.stop()
+
+    def test_configure_topic_partitions(self, broker):
+        client = MessagingClient(broker.url)
+        client.configure_topic("ns", "wide", partition_count=8)
+        cfg = client.new_publisher("ns", "wide")
+        assert cfg.partition_count == 8
+        cfg.close()
+
+    def test_delete_topic(self, broker):
+        client = MessagingClient(broker.url)
+        pub = client.new_publisher("ns", "temp", partition=0)
+        pub.publish(b"gone soon")
+        pub.close()
+        client.delete_topic("ns", "temp")
+        assert ("ns", "temp") not in broker._topics
