@@ -2,27 +2,13 @@
 
 Multi-chip hardware is unavailable in the dev loop; sharding logic is
 validated on 8 virtual CPU devices (the driver's dryrun_multichip does the
-same).
-
-NOTE: setting os.environ["JAX_PLATFORMS"] here is NOT enough — the image's
-sitecustomize imports jax at interpreter start (registering the remote
-'axon' TPU platform), so the env var is already captured. jax.config.update
-is the supported post-import override and must run before any backend is
-initialized (i.e. before the first jax.devices()/dispatch).
+same, via the same helper — see seaweedfs_tpu/util/cpu_mesh.py for why
+plain env vars are captured too late in this image).
 """
 
-import os
+from seaweedfs_tpu.util.cpu_mesh import force_cpu_platform
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
 
 
 import pytest
