@@ -9,7 +9,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional, Tuple
 
-from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.filer import filechunk_manifest, filechunks
 from seaweedfs_tpu.filer.filer_notify import MetaLog
 from seaweedfs_tpu.filer.filerstore import (
     FilerStore, FilerStoreWrapper, NotFound, join_path, normalize_path,
@@ -63,9 +63,33 @@ class Filer:
         # (wired to operation.delete_files by the filer server)
         self.on_delete_chunks: Callable[[List[filer_pb2.FileChunk]], None] = \
             lambda chunks: None
+        # chunk-bytes reader used to expand manifest chunks before delete
+        # (wired to the read path by the filer server; without it only the
+        # manifest blob itself can be GCed)
+        self.fetch_chunk_fn: Optional[
+            Callable[[filer_pb2.FileChunk], bytes]] = None
         # optional external queue: every event also published there
         # (reference filer.notify → weed/notification)
         self.notification_queue = None
+
+    def _delete_chunks(self, chunks: List[filer_pb2.FileChunk]) -> None:
+        """Hand chunks to the GC hook, expanding manifest chunks first.
+
+        For manifestized files (>1000 chunks) the entry holds only
+        manifest-blob chunks; the data chunks they reference must be
+        resolved and deleted too, or they are orphaned forever
+        (reference: weed/filer/filer_delete_entry.go ResolveChunkManifest).
+        The manifest blobs themselves stay in the delete list.
+        """
+        if (self.fetch_chunk_fn is not None
+                and filechunk_manifest.has_chunk_manifest(chunks)):
+            manifests, _ = filechunk_manifest.separate_manifest_chunks(chunks)
+            try:
+                chunks = filechunk_manifest.resolve_chunk_manifest(
+                    self.fetch_chunk_fn, list(chunks)) + manifests
+            except Exception:
+                pass  # delete what we can rather than fail the namespace op
+        self.on_delete_chunks(chunks)
 
     # -- event log ------------------------------------------------------------
 
@@ -123,7 +147,7 @@ class Filer:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
             if unused:
-                self.on_delete_chunks(unused)
+                self._delete_chunks(unused)
 
     def _ensure_parents(self, directory: str,
                         from_other_cluster: bool = False) -> None:
@@ -152,7 +176,7 @@ class Filer:
             # lazy TTL expiry like the reference: purge and report missing
             self.store.delete_entry(directory, name)
             if e.chunks:
-                self.on_delete_chunks(list(e.chunks))
+                self._delete_chunks(list(e.chunks))
             raise NotFound(full_path)
         return e
 
@@ -171,7 +195,7 @@ class Filer:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
             if unused:
-                self.on_delete_chunks(unused)
+                self._delete_chunks(unused)
 
     def append_chunks(self, full_path: str,
                       chunks: List[filer_pb2.FileChunk]) -> filer_pb2.Entry:
@@ -227,7 +251,7 @@ class Filer:
         self._notify(directory, entry, None, delete_chunks=delete_data,
                      from_other_cluster=from_other_cluster)
         if delete_data and chunks:
-            self.on_delete_chunks(chunks)
+            self._delete_chunks(chunks)
 
     def _collect_children(self, directory: str, recursive: bool,
                           ignore_error: bool) -> List[filer_pb2.FileChunk]:

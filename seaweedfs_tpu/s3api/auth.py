@@ -171,7 +171,12 @@ class Iam:
 
     @staticmethod
     def _canonical_uri(path: str) -> str:
-        return urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
+        # For the S3 service the canonical URI is the raw request path as
+        # the client sent it (AWS "no normalize" rule): real clients sign
+        # the encoded path, so unquote/quote round-tripping here would
+        # turn an encoded %2F in an object key into a literal '/' and
+        # break their signatures.
+        return path or "/"
 
     def _canonical_request(self, method: str, path: str, cq: str,
                            signed_headers: List[str],

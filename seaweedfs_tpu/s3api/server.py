@@ -22,9 +22,9 @@ import grpc
 from seaweedfs_tpu.filer import filechunks
 from seaweedfs_tpu.filer import http_client as filer_http
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
-from seaweedfs_tpu.s3api.auth import (ACTION_LIST, ACTION_READ,
-                                      ACTION_TAGGING, ACTION_WRITE, Iam,
-                                      S3AuthError)
+from seaweedfs_tpu.s3api.auth import (ACTION_ADMIN, ACTION_LIST,
+                                      ACTION_READ, ACTION_TAGGING,
+                                      ACTION_WRITE, Iam, S3AuthError)
 
 BUCKETS_DIR = "/buckets"
 MULTIPART_DIR = ".uploads"          # hidden dir inside the bucket
@@ -140,7 +140,12 @@ def _make_handler(s3: S3ApiServer):
                 self.send_header("Content-Type", content_type)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
-            self.send_header("Content-Length", str(len(body)))
+            # HEAD replies pass the object's Content-Length explicitly
+            # (a second zero-length one would violate RFC 7230), and 204
+            # replies MUST NOT carry Content-Length at all (RFC 9110 §8.6).
+            if code != 204 and not any(k.lower() == "content-length"
+                                       for k in (headers or {})):
+                self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             if self.command != "HEAD" and body:
                 self.wfile.write(body)
@@ -209,13 +214,16 @@ def _make_handler(s3: S3ApiServer):
 
         def _bucket_op(self, bucket: str, qs, payload: bytes):
             if self.command == "PUT":
-                self._auth(ACTION_ADMIN_OR_WRITE, bucket, payload)
+                # bucket creation is an admin action in the reference
+                # (s3api_server.go:93); Write identities must not be
+                # able to create buckets
+                self._auth(ACTION_ADMIN, bucket, payload)
                 s3.stub.CreateEntry(filer_pb2.CreateEntryRequest(
                     directory=BUCKETS_DIR,
                     entry=filer_pb2.Entry(name=bucket, is_directory=True)))
                 self._reply(200)
             elif self.command == "DELETE":
-                self._auth(ACTION_ADMIN_OR_WRITE, bucket, payload)
+                self._auth(ACTION_WRITE, bucket, payload)
                 s3.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
                     directory=BUCKETS_DIR, name=bucket,
                     is_delete_data=True, is_recursive=True,
@@ -465,7 +473,9 @@ def _make_handler(s3: S3ApiServer):
             wanted = self._manifest_part_numbers(payload)
             if wanted is not None:
                 parts = [e for e in parts if int(e.name[:-5]) in wanted]
-            parts.sort(key=lambda e: e.name)
+            # numeric sort: part 10000 (5 digits) would lexicographically
+            # sort between 0999 and 2000 and corrupt the assembled object
+            parts.sort(key=lambda e: int(e.name[:-5]))
             final = filer_pb2.Entry(name=_name_of(key))
             mime = meta.extended.get("mime", b"").decode()
             if mime:
@@ -583,8 +593,6 @@ def _make_handler(s3: S3ApiServer):
 
     return Handler
 
-
-ACTION_ADMIN_OR_WRITE = ACTION_WRITE
 
 
 # -- helpers ------------------------------------------------------------------

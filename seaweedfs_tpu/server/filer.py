@@ -60,6 +60,9 @@ class FilerServer:
         self.filer = Filer(backend,
                            log_dir=f"{meta_dir}/logs" if meta_dir else None)
         self.filer.on_delete_chunks = self._delete_chunks_async
+        self.filer.fetch_chunk_fn = lambda c: stream.fetch_chunk_bytes(
+            self.lookup_fid_urls, c.file_id, bytes(c.cipher_key),
+            c.is_compressed)
         self.chunk_cache = TieredChunkCache(
             disk_dir=f"{cache_dir}/chunks" if cache_dir else None)
         self.master_client = MasterClient(

@@ -207,6 +207,33 @@ class TestMetaLogReplay:
 
 
 class TestReviewRegressions:
+    def test_delete_resolves_manifest_chunks(self, tmp_path):
+        """Deleting a manifestized file must GC the data chunks each
+        manifest references, not just the manifest blob itself."""
+        from seaweedfs_tpu.filer import filechunk_manifest
+
+        data_chunks = [
+            filer_pb2.FileChunk(file_id=f"3,{i:02x}", offset=i * 10, size=10)
+            for i in range(4)]
+        manifest = filer_pb2.FileChunkManifest(chunks=data_chunks)
+        blobs = {"9,aa": manifest.SerializeToString()}
+        mchunk = filer_pb2.FileChunk(
+            file_id="9,aa", size=40, is_chunk_manifest=True)
+
+        f = Filer(MemoryStore(), log_dir=str(tmp_path / "logs"),
+                  flush_seconds=60)
+        deleted = []
+        f.on_delete_chunks = deleted.extend
+        f.fetch_chunk_fn = lambda c: blobs[c.file_id]
+        e = new_entry("big.bin")
+        e.chunks.append(mchunk)
+        f.create_entry("/dir", e)
+        f.delete_entry("/dir/big.bin", delete_data=True)
+        got = sorted(c.file_id for c in deleted)
+        assert got == sorted(
+            [c.file_id for c in data_chunks] + ["9,aa"])
+        f.close()
+
     def test_sqlite_underscore_not_wildcard_in_subtree_delete(self, tmp_path):
         """'_' in a directory name must not match arbitrary chars when
         deleting a subtree (regression: sibling buckets were wiped)."""

@@ -14,8 +14,9 @@ import xml.etree.ElementTree as ET
 import pytest
 
 from seaweedfs_tpu.s3api import Credential, Iam, Identity, S3ApiServer
-from seaweedfs_tpu.s3api.auth import (ACTION_READ, ACTION_WRITE,
-                                      ACTION_LIST, ACTION_TAGGING)
+from seaweedfs_tpu.s3api.auth import (ACTION_ADMIN, ACTION_READ,
+                                      ACTION_WRITE, ACTION_LIST,
+                                      ACTION_TAGGING)
 from tests.cluster_util import Cluster, free_port_pair
 
 ACCESS, SECRET = "AKIDEXAMPLE", "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
@@ -82,8 +83,7 @@ def cluster(tmp_path_factory):
     iam = Iam([Identity(
         name="admin",
         credentials=[Credential(ACCESS, SECRET)],
-        actions=[ACTION_READ, ACTION_WRITE, ACTION_LIST,
-                 ACTION_TAGGING])])
+        actions=[ACTION_ADMIN])])
     c.s3 = S3ApiServer(filer_url=c.filer.url, port=free_port_pair(),
                        iam=iam)
     c.s3.start()
@@ -308,6 +308,8 @@ class TestAuth:
     def test_action_scoping(self, tmp_path):
         c = Cluster(tmp_path, n_volume_servers=1, with_filer=True)
         iam = Iam([
+            Identity("boss", [Credential("AKEY", "ASECRET")],
+                     [ACTION_ADMIN]),
             Identity("writer", [Credential("WKEY", "WSECRET")],
                      [ACTION_WRITE, ACTION_LIST]),
             Identity("reader", [Credential("RKEY", "RSECRET")],
@@ -317,9 +319,14 @@ class TestAuth:
                           iam=iam)
         srv.start()
         try:
+            a = SigV4Client(srv.url, "AKEY", "ASECRET")
             w = SigV4Client(srv.url, "WKEY", "WSECRET")
             r = SigV4Client(srv.url, "RKEY", "RSECRET")
-            with w.request("PUT", "/scoped"):
+            # bucket creation is admin-only (reference s3api_server.go:93)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                w.request("PUT", "/scoped")
+            assert ei.value.code == 403
+            with a.request("PUT", "/scoped"):
                 pass
             with w.request("PUT", "/scoped/f.txt", data=b"data"):
                 pass
@@ -339,6 +346,18 @@ class TestAuth:
 
 
 class TestReviewRegressions:
+    def test_head_single_content_length(self, cluster, s3c):
+        """HEAD object must carry exactly one Content-Length (the
+        object's) — a second automatic zero-length header is an RFC 7230
+        violation strict clients reject."""
+        with s3c.request("PUT", "/hbkt"):
+            pass
+        with s3c.request("PUT", "/hbkt/obj", data=b"elevenbytes"):
+            pass
+        with s3c.request("HEAD", "/hbkt/obj") as r:
+            lens = r.headers.get_all("Content-Length")
+        assert lens == ["11"]
+
     def test_listing_is_lexicographic_across_dirs(self, cluster, s3c):
         """'a.txt' sorts before 'a/x' ('.' < '/'); marker pagination
         must honor global key order, not directory traversal order."""
