@@ -40,6 +40,16 @@ def coding_matrix(data_shards: int = DATA_SHARDS,
     return m
 
 
+class _Resolved:
+    """Already-computed stand-in for PendingApply (sync backends)."""
+
+    def __init__(self, value: np.ndarray):
+        self._value = value
+
+    def result(self) -> np.ndarray:
+        return self._value
+
+
 class ReedSolomon:
     def __init__(self, data_shards: int = DATA_SHARDS,
                  parity_shards: int = PARITY_SHARDS,
@@ -103,6 +113,23 @@ class ReedSolomon:
         if data.shape[-2] != self.data_shards:
             raise ValueError(f"expected {self.data_shards} data shards")
         return self._apply(self.matrix[self.data_shards:], data)
+
+    def encode_async(self, data: np.ndarray):
+        """Pipelined encode: returns a handle with .result() -> parity.
+
+        On the jax backend the dispatch is issued immediately and the
+        device computes while the caller does host IO; other backends
+        compute synchronously and return a pre-resolved handle, so
+        pipeline-structured callers work uniformly.
+        """
+        data = np.asarray(data, dtype=np.uint8)
+        if data.shape[-2] != self.data_shards:
+            raise ValueError(f"expected {self.data_shards} data shards")
+        if self.backend == "jax":
+            from seaweedfs_tpu.ops import rs_kernel
+            return rs_kernel.apply_matrix_async(
+                self.matrix[self.data_shards:], data)
+        return _Resolved(self._apply(self.matrix[self.data_shards:], data))
 
     def encode_all(self, data: np.ndarray) -> np.ndarray:
         """data: [..., D, N] -> all shards [..., D+P, N]."""

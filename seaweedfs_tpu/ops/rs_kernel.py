@@ -109,6 +109,47 @@ def apply_matrix(matrix: np.ndarray, shards) -> np.ndarray:
     [S, B*N] are the same computation, and the 2D shape keeps XLA in its
     well-tiled matmul path (batched 3D int8 einsums compile poorly).
     """
+    return apply_matrix_async(matrix, shards).result()
+
+
+class PendingApply:
+    """An in-flight GF linear map: device dispatch already issued, result
+    fetched (and slab padding stripped) on .result().
+
+    JAX dispatch is asynchronous, so holding several of these overlaps
+    device compute with host-side disk IO — the double-buffered encode
+    stream SURVEY §7 calls for (vs the reference's serial 256KB loop,
+    ec_encoder.go:120-136).
+    """
+
+    def __init__(self, parts, o: int, n: int, batch_shape, lanes: int):
+        self._parts = parts          # [(device_array, want, pos)]
+        self._o = o
+        self._n = n
+        self._batch_shape = batch_shape
+        self._lanes = lanes
+
+    def result(self) -> np.ndarray:
+        o, n = self._o, self._n
+        if n == 0:
+            return np.zeros(self._batch_shape + (o, 0), dtype=np.uint8)
+        out = np.empty((o, n), dtype=np.uint8)
+        for res, want, pos in self._parts:
+            out[:, pos:pos + want] = np.asarray(res)[:, :want]
+        if self._batch_shape:
+            out = np.moveaxis(
+                out.reshape(o, -1, self._lanes), 0, 1).reshape(
+                self._batch_shape + (o, self._lanes))
+        return out
+
+
+def apply_matrix_async(matrix: np.ndarray, shards) -> PendingApply:
+    """Dispatch apply_matrix without waiting for the device.
+
+    Returns a PendingApply whose .result() blocks. Between submit and
+    fetch the host is free to read the next slab from disk / write the
+    previous one — the caller-visible half of the streaming pipeline.
+    """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     m2 = _m2_device(matrix.tobytes(), matrix.shape[0], matrix.shape[1])
     shards = np.asarray(shards, dtype=np.uint8)
@@ -116,17 +157,14 @@ def apply_matrix(matrix: np.ndarray, shards) -> np.ndarray:
     s, n = shards.shape[-2:]
     o = matrix.shape[0]
     if n == 0:
-        return np.zeros(batch_shape + (o, 0), dtype=np.uint8)
+        return PendingApply([], o, 0, batch_shape, n)
     if batch_shape:
         flat = np.ascontiguousarray(
             np.moveaxis(shards.reshape((-1, s, n)), 1, 0)).reshape(s, -1)
     else:
         flat = shards
-    out = _dispatch_slabs(m2, flat, o)
-    if batch_shape:
-        out = np.moveaxis(out.reshape(o, -1, n), 0, 1).reshape(
-            batch_shape + (o, n))
-    return out
+    parts = _submit_slabs(m2, flat)
+    return PendingApply(parts, o, flat.shape[1], batch_shape, n)
 
 
 # Dispatch in fixed, power-of-two lane widths. Every distinct shape costs
@@ -138,11 +176,10 @@ _MIN_SLAB = 1 << 16   # 64KB
 _MAX_SLAB = 1 << 22   # 4MB lanes per dispatch (40MB data for S=10)
 
 
-def _dispatch_slabs(m2: jnp.ndarray, flat: np.ndarray, o: int) -> np.ndarray:
+def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray):
+    """Issue one async dispatch per power-of-two slab; no fetches."""
     s, n = flat.shape
-    if n == 0:
-        return np.zeros((o, 0), dtype=np.uint8)
-    out = np.empty((o, n), dtype=np.uint8)
+    parts = []
     pos = 0
     while pos < n:
         want = min(n - pos, _MAX_SLAB)
@@ -154,7 +191,6 @@ def _dispatch_slabs(m2: jnp.ndarray, flat: np.ndarray, o: int) -> np.ndarray:
             padded = np.zeros((s, slab), dtype=np.uint8)
             padded[:, :want] = chunk
             chunk = padded
-        res = np.asarray(_gf_linear_jit(m2, jnp.asarray(chunk)))
-        out[:, pos:pos + want] = res[:, :want]
+        parts.append((_gf_linear_jit(m2, jnp.asarray(chunk)), want, pos))
         pos += want
-    return out
+    return parts

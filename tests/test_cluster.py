@@ -295,3 +295,60 @@ def test_batch_delete_removes_all_replicas(cluster):
         with pytest.raises(urllib.error.HTTPError) as ei:
             cluster.http(f"{url}/{fid}")
         assert ei.value.code == 404
+
+
+def test_ec_encode_jax_backend_through_rpc(cluster):
+    """ec shards generated through VolumeEcShardsGenerate with the jax
+    (TPU-kernel) backend must be byte-identical to the numpy backend's —
+    the full RPC lifecycle must exercise the streaming TPU path, not
+    just the library surface (round-1 review weak spot #3)."""
+    import glob
+
+    datas = [os.urandom(2048) for _ in range(5)]
+    fids = [cluster.upload(d, collection="jec") for d in datas]
+    vid = parse_fid(fids[0]).volume_id
+    owner_url = cluster.master.lookup_locations(vid, "jec")[0][0]
+    vs = next(v for v in cluster.volume_servers if v.url == owner_url)
+    stub = volume_stub(owner_url)
+    stub.VolumeMarkReadonly(
+        volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+
+    def shard_bytes():
+        out = {}
+        for d in (loc.directory for loc in vs.store.locations):
+            for p in glob.glob(os.path.join(d, f"*{vid}.ec??")):
+                with open(p, "rb") as f:
+                    out[os.path.basename(p)] = f.read()
+        return out
+
+    stub.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection="jec", encoder="jax"))
+    jax_shards = shard_bytes()
+    assert len(jax_shards) == 14
+    for name in jax_shards:
+        os.remove(next(
+            p for d in (loc.directory for loc in vs.store.locations)
+            for p in glob.glob(os.path.join(d, f"*{vid}.ec??"))
+            if os.path.basename(p) == name))
+    stub.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection="jec", encoder="numpy"))
+    numpy_shards = shard_bytes()
+    assert jax_shards == numpy_shards
+
+    # the jax-encoded shards must also serve reads through the EC path
+    stub.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection="jec", encoder="jax"))
+    stub.VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=vid, collection="jec", shard_ids=list(range(14))))
+    stub.VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+    cluster.wait_for(lambda: cluster.master.topo.lookup_ec(vid),
+                     what="ec shards in topology")
+    for fid, d in zip(fids, datas):
+        if parse_fid(fid).volume_id == vid:
+            with cluster.fetch(fid) as r:
+                assert r.read() == d
