@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 import grpc
 
 from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.filer import http_client as filer_http
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
 from seaweedfs_tpu.s3api.auth import (ACTION_ADMIN, ACTION_LIST,
@@ -30,6 +31,9 @@ BUCKETS_DIR = "/buckets"
 MULTIPART_DIR = ".uploads"          # hidden dir inside the bucket
 S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 TAG_PREFIX = "x-amz-tag-"
+
+
+log = wlog.logger("s3")
 
 
 class S3ApiServer:
@@ -53,6 +57,8 @@ class S3ApiServer:
             target=self._http_server.serve_forever,
             name=f"s3-http-{self.port}", daemon=True)
         self._http_thread.start()
+        log.info("s3 gateway %s:%d started (filer=%s)",
+                 self.ip, self.port, self.filer_url)
 
     def stop(self) -> None:
         if self._http_server:

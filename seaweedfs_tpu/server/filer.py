@@ -20,6 +20,7 @@ from typing import List, Optional
 import grpc
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.filer import (Filer, FilerError, MemoryStore, NotFound,
                                  SqliteStore, filechunks, stream)
 from seaweedfs_tpu.filer.filechunk_manifest import maybe_manifestize
@@ -33,6 +34,9 @@ from seaweedfs_tpu.util.cipher import encrypt
 from seaweedfs_tpu.wdclient.masterclient import MasterClient
 
 DEFAULT_CHUNK_SIZE = 8 << 20   # -maxMB analog
+
+
+log = wlog.logger("filer")
 
 
 class FilerServer:
@@ -89,6 +93,9 @@ class FilerServer:
             name=f"filer-http-{self.port}", daemon=True)
         self._http_thread.start()
         self.master_client.start()
+        log.info("filer %s:%d started (store=%s, master=%s)",
+                 self.ip, self.port, type(self.filer.store).__name__,
+                 self.master_url)
 
     def stop(self) -> None:
         self._stopping = True

@@ -23,12 +23,16 @@ from typing import Dict, List, Optional, Set
 from urllib.parse import parse_qs, urlparse
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.pb import master_pb2, volume_server_pb2, volume_stub
 from seaweedfs_tpu.server import convert
 from seaweedfs_tpu.storage.superblock import ReplicaPlacement
 from seaweedfs_tpu.topology.sequence import MemorySequencer
 from seaweedfs_tpu.topology.topology import Topology
 from seaweedfs_tpu.topology.volume_growth import NoFreeSlots, VolumeGrowth, growth_count
+
+
+log = wlog.logger("master")
 
 
 class AdminLock:
@@ -106,8 +110,11 @@ class MasterServer:
             target=self._http_server.serve_forever, name="master-http",
             daemon=True)
         self._http_thread.start()
+        log.info("master %s started (grpc :%d)", self.url,
+                 self.port + rpc.GRPC_PORT_OFFSET)
 
     def stop(self) -> None:
+        log.info("master %s stopping", self.url)
         self._stopping = True
         self._save_sequence()
         if self._http_server:
@@ -166,6 +173,10 @@ class MasterServer:
                 prev = self.topo.find_node(node_url)
                 before = (set(prev.volumes) | set(prev.ec_shards)) \
                     if prev else set()
+                if prev is None:
+                    log.info("volume server %s connected (dc=%s rack=%s)",
+                             node_url, hb.data_center or "DefaultDataCenter",
+                             hb.rack or "DefaultRack")
                 node = self.topo.sync_heartbeat(
                     d, dc=hb.data_center or "DefaultDataCenter",
                     rack=hb.rack or "DefaultRack")
@@ -188,6 +199,9 @@ class MasterServer:
                 node = self.topo.find_node(node_url)
                 if node is not None:
                     gone = sorted(set(node.volumes) | set(node.ec_shards))
+                    log.warning("volume server %s disconnected; "
+                                "unregistering %d volumes/shards",
+                                node_url, len(gone))
                     self.topo.unregister_node(node_url)
                     if gone:
                         self._broadcast(master_pb2.VolumeLocation(

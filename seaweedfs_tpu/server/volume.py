@@ -24,6 +24,8 @@ from urllib.parse import parse_qs, urlparse
 import grpc
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util.throttler import Throttler
 from seaweedfs_tpu.ec import store_ec
 from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
 from seaweedfs_tpu.ec.encoder import shard_file_name
@@ -38,6 +40,8 @@ from seaweedfs_tpu.storage.needle import (FLAG_IS_COMPRESSED, CookieMismatch,
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.superblock import TTL
 
+log = wlog.logger("volume")
+
 COPY_CHUNK = 1 << 20
 EC_LOCATION_TTL = 60.0  # seconds a cached shard-location set stays fresh
 
@@ -47,7 +51,8 @@ class VolumeServer:
                  ip: str = "127.0.0.1", port: int = 8080,
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_counts: Optional[List[int]] = None,
-                 pulse_seconds: float = 5.0, ec_encoder: str = "auto"):
+                 pulse_seconds: float = 5.0, ec_encoder: str = "auto",
+                 compaction_mbps: float = 0.0):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -55,6 +60,7 @@ class VolumeServer:
         self.rack = rack
         self.pulse_seconds = pulse_seconds
         self.ec_encoder = ec_encoder
+        self.compaction_mbps = compaction_mbps
         self.store = Store(directories, max_volume_counts, ip=ip, port=port,
                            public_url=public_url)
         self.volume_size_limit = 30 << 30
@@ -89,8 +95,12 @@ class VolumeServer:
             target=self._heartbeat_loop, name=f"heartbeat-{self.port}",
             daemon=True)
         self._hb_thread.start()
+        log.info("volume server %s:%d started (grpc :%d, dirs %s)",
+                 self.ip, self.port, self.port + rpc.GRPC_PORT_OFFSET,
+                 [loc.directory for loc in self.store.locations])
 
     def stop(self) -> None:
+        log.info("volume server %s:%d stopping", self.ip, self.port)
         self._stopping = True
         self._hb_wake.set()
         if self._hb_call is not None:
@@ -116,14 +126,22 @@ class VolumeServer:
             try:
                 stub = master_stub(self.master_url)
                 self._hb_call = stub.SendHeartbeat(self._heartbeat_gen())
+                connected = False
                 for resp in self._hb_call:
+                    if not connected:
+                        connected = True
+                        log.info("heartbeat stream to master %s established",
+                                 self.master_url)
                     if resp.volume_size_limit:
                         self.volume_size_limit = resp.volume_size_limit
                     if self._stopping:
                         return
-            except grpc.RpcError:
+            except grpc.RpcError as e:
                 if self._stopping:
                     return
+                log.warning("heartbeat stream to master %s broken (%s); "
+                            "reconnecting", self.master_url,
+                            getattr(e, "code", lambda: e)())
                 time.sleep(min(self.pulse_seconds, 1.0))
 
     def trigger_heartbeat(self) -> None:
@@ -232,7 +250,8 @@ class VolumeServer:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"volume {request.volume_id} not found")
         self.compact_states[v.id] = vacuum_mod.compact(
-            v, preallocate=request.preallocate)
+            v, preallocate=request.preallocate,
+            compaction_mbps=self.compaction_mbps)
         return volume_server_pb2.VacuumVolumeCompactResponse()
 
     def VacuumVolumeCommit(self, request, context):
@@ -296,6 +315,7 @@ class VolumeServer:
                           f"no file for vid={request.volume_id} "
                           f"ext={request.ext}")
         stop = request.stop_offset or os.path.getsize(path)
+        throttler = Throttler(self.compaction_mbps)
         with open(path, "rb") as f:
             sent = 0
             while sent < stop:
@@ -303,6 +323,7 @@ class VolumeServer:
                 if not chunk:
                     break
                 sent += len(chunk)
+                throttler.maybe_slowdown(len(chunk))
                 yield volume_server_pb2.CopyFileResponse(file_content=chunk)
 
     def _file_path_for_copy(self, request) -> Optional[str]:

@@ -29,6 +29,7 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import Needle, NeedleError, actual_size
 from seaweedfs_tpu.storage.superblock import SuperBlock
 from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.util.throttler import Throttler
 
 
 @dataclasses.dataclass
@@ -39,7 +40,8 @@ class CompactState:
     new_offsets: Dict[int, Tuple[int, int]]  # key -> (offset in .cpd, size)
 
 
-def compact(v: Volume, preallocate: int = 0) -> CompactState:
+def compact(v: Volume, preallocate: int = 0,
+            compaction_mbps: float = 0.0) -> CompactState:
     """Phase 1: copy live needles into <base>.cpd/.cpx.
 
     Runs without blocking the write path (scan uses its own fd; the
@@ -55,6 +57,7 @@ def compact(v: Volume, preallocate: int = 0) -> CompactState:
     )
     scanned_until = v.content_size
     new_offsets: Dict[int, Tuple[int, int]] = {}
+    throttler = Throttler(compaction_mbps)
     with open(cpd_path, "wb") as out:
         out.write(new_sb.to_bytes())
         pos = out.tell()
@@ -75,6 +78,7 @@ def compact(v: Volume, preallocate: int = 0) -> CompactState:
                 out.write(b"\x00" * pad)
                 pos += pad
             out.write(blob)
+            throttler.maybe_slowdown(len(blob))
             new_offsets[n.id] = (pos, n.size)
             pos += len(blob)
     with open(cpx_path, "wb") as out:

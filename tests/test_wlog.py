@@ -1,0 +1,31 @@
+"""Logging + throttler wiring (reference weed/glog, weed/util/throttler.go)."""
+
+import logging
+from seaweedfs_tpu.util import wlog
+
+
+def test_logger_format_and_level(capsys):
+    wlog.configure(verbosity=0)
+    log = wlog.logger("testcomp")
+    log.info("hello %d", 42)
+    err = capsys.readouterr().err
+    assert "seaweedfs_tpu.testcomp] hello 42" in err
+    assert err.startswith("I")  # glog-style severity prefix
+
+
+def test_verbosity_guard():
+    wlog.configure(verbosity=0)
+    assert not wlog.v(1)
+    wlog.set_verbosity(2)
+    assert wlog.v(1) and wlog.v(2) and not wlog.v(3)
+    wlog.set_verbosity(0)
+
+
+def test_log_file(tmp_path):
+    path = tmp_path / "weed.log"
+    wlog.configure(verbosity=0, log_file=str(path), stderr=False)
+    wlog.logger("x").warning("disk full")
+    for h in logging.getLogger("seaweedfs_tpu").handlers:
+        h.flush()
+    assert "disk full" in path.read_text()
+    wlog.configure(verbosity=0)  # restore default handlers
