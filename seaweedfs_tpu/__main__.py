@@ -1,0 +1,9 @@
+"""Entry point: ``python -m seaweedfs_tpu <command>`` — the single
+binary (reference weed/weed.go:37)."""
+
+import sys
+
+from seaweedfs_tpu.command import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""The single-binary command layer: ``python -m seaweedfs_tpu <cmd>``.
+
+The reference ships one ``weed`` binary whose subcommand table lives in
+weed/command/command.go:10-34 and dispatches from weed/weed.go:37.  Here
+each subcommand is a module registering ``name -> (run, help)``; global
+flags (-v verbosity, -logFile) are peeled off before dispatch, matching
+the reference's glog flags.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict, Tuple
+
+from seaweedfs_tpu.util import wlog
+
+COMMANDS: Dict[str, Tuple[Callable, str]] = {}
+
+
+def command(name: str, help_text: str):
+    def deco(fn):
+        COMMANDS[name] = (fn, help_text)
+        return fn
+    return deco
+
+
+def _usage(out=sys.stderr) -> None:
+    print("usage: python -m seaweedfs_tpu [-v N] [-logFile PATH] "
+          "<command> [args]\n\ncommands:", file=out)
+    for name in sorted(COMMANDS):
+        print(f"  {name:<16} {COMMANDS[name][1]}", file=out)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    # Global flags before the subcommand (glog-style).  Matched exactly
+    # by hand: argparse's prefix matching would swallow subcommand flags
+    # like -volumeSizeLimitMB as "-v olumeSizeLimitMB".
+    verbosity, log_file = None, None
+    rest = argv
+    while rest:
+        if rest[0] == "-v" and len(rest) >= 2:
+            try:
+                verbosity = int(rest[1])
+            except ValueError:
+                print(f"-v expects an integer, got {rest[1]!r}",
+                      file=sys.stderr)
+                _usage()
+                return 2
+            rest = rest[2:]
+        elif rest[0] == "-logFile" and len(rest) >= 2:
+            log_file, rest = rest[1], rest[2:]
+        else:
+            break
+    if verbosity is not None or log_file:
+        wlog.configure(verbosity=verbosity, log_file=log_file)
+
+    if not rest or rest[0] in ("-h", "--help", "help"):
+        _usage(sys.stdout if rest and rest[0] != "-h" else sys.stderr)
+        return 0 if rest else 2
+    name, args = rest[0], rest[1:]
+    entry = COMMANDS.get(name)
+    if entry is None:
+        print(f"unknown command {name!r}", file=sys.stderr)
+        _usage()
+        return 2
+    fn, _ = entry
+    try:
+        return fn(args) or 0
+    except KeyboardInterrupt:
+        return 130
+
+
+# registration side effects
+from seaweedfs_tpu.command import servers  # noqa: E402,F401
+from seaweedfs_tpu.command import tools  # noqa: E402,F401
+from seaweedfs_tpu.command import benchmark  # noqa: E402,F401

@@ -1,0 +1,280 @@
+"""Server subcommands: master / volume / filer / s3 / webdav / server.
+
+Flag names and defaults mirror the reference command layer
+(weed/command/master.go:29-46, volume.go:65-90, filer.go:43-67,
+s3.go:25-35, webdav.go:20-29, server.go) so a ``weed`` user can switch
+with the same flags.  Each subcommand blocks until SIGINT/SIGTERM, then
+stops its servers via the grace hooks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+from seaweedfs_tpu.command import command
+from seaweedfs_tpu.util import grace, wlog
+
+log = wlog.logger("command")
+
+
+def _serve_forever(stoppables: List) -> int:
+    done = threading.Event()
+    for s in stoppables:
+        grace.on_interrupt(s.stop)
+    grace.on_interrupt(done.set)
+    try:
+        while not done.is_set():
+            time.sleep(0.5)
+    finally:
+        grace.run_hooks()
+    return 0
+
+
+def _split_dirs(dir_flag: str) -> List[str]:
+    dirs = [d.strip() for d in dir_flag.split(",") if d.strip()]
+    for d in dirs:
+        os.makedirs(d, exist_ok=True)
+    return dirs
+
+
+def _master_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="master", description="start a master")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=9333)
+    p.add_argument("-mdir", default=None,
+                   help="data directory for sequence/raft state")
+    p.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb",
+                   type=int, default=30 * 1000)
+    p.add_argument("-defaultReplication", dest="default_replication",
+                   default="000")
+    p.add_argument("-garbageThreshold", dest="garbage_threshold",
+                   type=float, default=0.3)
+    p.add_argument("-pulseSeconds", dest="pulse_seconds", type=float,
+                   default=5.0)
+    p.add_argument("-cpuprofile", default=None)
+    return p
+
+
+def _build_master(opts):
+    from seaweedfs_tpu.server.master import MasterServer
+    if opts.mdir:
+        os.makedirs(opts.mdir, exist_ok=True)
+    return MasterServer(
+        ip=opts.ip, port=opts.port, meta_dir=opts.mdir,
+        volume_size_limit_mb=opts.volume_size_limit_mb,
+        default_replication=opts.default_replication,
+        pulse_seconds=opts.pulse_seconds,
+        garbage_threshold=opts.garbage_threshold,
+    )
+
+
+@command("master", "start a master server (control plane)")
+def run_master(args) -> int:
+    opts = _master_parser().parse_args(args)
+    grace.setup_profiling(opts.cpuprofile)
+    m = _build_master(opts)
+    m.start()
+    return _serve_forever([m])
+
+
+def _volume_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="volume", description="start a "
+                                "volume server")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument("-dir", default="./data",
+                   help="comma-separated storage directories")
+    p.add_argument("-max", default="7",
+                   help="comma-separated max volume counts per dir")
+    p.add_argument("-mserver", default="127.0.0.1:9333")
+    p.add_argument("-publicUrl", dest="public_url", default="")
+    p.add_argument("-dataCenter", dest="data_center", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-pulseSeconds", dest="pulse_seconds", type=float,
+                   default=5.0)
+    p.add_argument("-compactionMBps", dest="compaction_mbps", type=float,
+                   default=0.0)
+    p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
+                   choices=["auto", "jax", "native", "numpy"])
+    p.add_argument("-cpuprofile", default=None)
+    return p
+
+
+def _build_volume(opts):
+    from seaweedfs_tpu.server.volume import VolumeServer
+    dirs = _split_dirs(opts.dir)
+    maxes = [int(x) for x in str(opts.max).split(",")]
+    if len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    return VolumeServer(
+        opts.mserver, dirs, ip=opts.ip, port=opts.port,
+        public_url=opts.public_url, data_center=opts.data_center,
+        rack=opts.rack, max_volume_counts=maxes,
+        pulse_seconds=opts.pulse_seconds, ec_encoder=opts.ec_encoder,
+        compaction_mbps=opts.compaction_mbps)
+
+
+@command("volume", "start a volume server (data plane)")
+def run_volume(args) -> int:
+    opts = _volume_parser().parse_args(args)
+    grace.setup_profiling(opts.cpuprofile)
+    vs = _build_volume(opts)
+    vs.start()
+    return _serve_forever([vs])
+
+
+def _filer_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="filer", description="start a filer")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-store", default="sqlite",
+                   help="metadata store: memory | sqlite")
+    p.add_argument("-dir", default="./filer",
+                   help="directory for metadata store + event log")
+    p.add_argument("-collection", default="")
+    p.add_argument("-defaultReplicaPlacement", dest="replication",
+                   default="")
+    p.add_argument("-maxMB", dest="max_mb", type=int, default=32,
+                   help="auto-chunking split size")
+    p.add_argument("-encryptVolumeData", dest="cipher",
+                   action="store_true")
+    return p
+
+
+def _build_filer(opts):
+    from seaweedfs_tpu.server.filer import FilerServer
+    os.makedirs(opts.dir, exist_ok=True)
+    return FilerServer(
+        opts.master, ip=opts.ip, port=opts.port, store=opts.store,
+        meta_dir=opts.dir, collection=opts.collection,
+        replication=opts.replication,
+        chunk_size=opts.max_mb << 20, cipher=opts.cipher,
+        cache_dir=os.path.join(opts.dir, "cache"))
+
+
+@command("filer", "start a filer (namespace server)")
+def run_filer(args) -> int:
+    opts = _filer_parser().parse_args(args)
+    fs = _build_filer(opts)
+    fs.start()
+    return _serve_forever([fs])
+
+
+def _load_iam(config_path: Optional[str]):
+    """IAM identities from an s3.configure-style JSON file:
+    {"identities": [{"name":..., "credentials": [{"accessKey":...,
+    "secretKey":...}], "actions": ["Read","Write",...]}]}"""
+    from seaweedfs_tpu.s3api.auth import Iam, Identity, Credential
+    if not config_path:
+        return Iam()
+    with open(config_path) as f:
+        cfg = json.load(f)
+    idents = []
+    for ident in cfg.get("identities", []):
+        creds = [Credential(c["accessKey"], c["secretKey"])
+                 for c in ident.get("credentials", [])]
+        idents.append(Identity(name=ident.get("name", ""),
+                               credentials=creds,
+                               actions=ident.get("actions", [])))
+    return Iam(idents)
+
+
+def _s3_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="s3", description="start an S3 "
+                                "gateway on a filer")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-config", default=None,
+                   help="JSON file with IAM identities")
+    return p
+
+
+@command("s3", "start an S3-compatible gateway")
+def run_s3(args) -> int:
+    opts = _s3_parser().parse_args(args)
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    s3 = S3ApiServer(opts.filer, ip=opts.ip, port=opts.port,
+                     iam=_load_iam(opts.config))
+    s3.start()
+    return _serve_forever([s3])
+
+
+def _webdav_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="webdav", description="start a "
+                                "WebDAV gateway on a filer")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    return p
+
+
+@command("webdav", "start a WebDAV gateway")
+def run_webdav(args) -> int:
+    opts = _webdav_parser().parse_args(args)
+    from seaweedfs_tpu.server.webdav import WebDavServer
+    wd = WebDavServer(opts.filer, ip=opts.ip, port=opts.port)
+    wd.start()
+    return _serve_forever([wd])
+
+
+@command("server", "start master + volume (+filer, +s3) in one process")
+def run_server(args) -> int:
+    p = argparse.ArgumentParser(prog="server", description="combined "
+                                "cluster-in-one-process (reference weed "
+                                "server)")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-dir", default="./data")
+    p.add_argument("-master.port", dest="master_port", type=int,
+                   default=9333)
+    p.add_argument("-volume.port", dest="volume_port", type=int,
+                   default=8080)
+    p.add_argument("-volume.max", dest="volume_max", default="7")
+    p.add_argument("-filer", action="store_true",
+                   help="also start a filer")
+    p.add_argument("-filer.port", dest="filer_port", type=int,
+                   default=8888)
+    p.add_argument("-s3", action="store_true",
+                   help="also start an S3 gateway (implies -filer)")
+    p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    p.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb",
+                   type=int, default=30 * 1000)
+    opts = p.parse_args(args)
+
+    mopts = _master_parser().parse_args(
+        ["-ip", opts.ip, "-port", str(opts.master_port),
+         "-mdir", os.path.join(opts.dir, "master"),
+         "-volumeSizeLimitMB", str(opts.volume_size_limit_mb)])
+    master = _build_master(mopts)
+    master.start()
+
+    vopts = _volume_parser().parse_args(
+        ["-ip", opts.ip, "-port", str(opts.volume_port),
+         "-dir", os.path.join(opts.dir, "volume"),
+         "-max", str(opts.volume_max),
+         "-mserver", f"{opts.ip}:{opts.master_port}"])
+    vol = _build_volume(vopts)
+    vol.start()
+
+    stack = [master, vol]
+    if opts.filer or opts.s3:
+        fopts = _filer_parser().parse_args(
+            ["-ip", opts.ip, "-port", str(opts.filer_port),
+             "-master", f"{opts.ip}:{opts.master_port}",
+             "-dir", os.path.join(opts.dir, "filer")])
+        filer = _build_filer(fopts)
+        filer.start()
+        stack.append(filer)
+        if opts.s3:
+            from seaweedfs_tpu.s3api.server import S3ApiServer
+            s3 = S3ApiServer(f"{opts.ip}:{opts.filer_port}", ip=opts.ip,
+                             port=opts.s3_port)
+            s3.start()
+            stack.append(s3)
+    return _serve_forever(stack)

@@ -1,0 +1,232 @@
+"""Client/ops subcommands: shell, upload, download, delete, scaffold,
+fix, export, version.
+
+Reference: weed/command/shell.go, upload.go, download.go, scaffold.go,
+fix.go:21-100 (rebuild .idx by scanning .dat), export.go (dump needles
+to tar).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from seaweedfs_tpu.command import command
+
+
+@command("version", "print version")
+def run_version(args) -> int:
+    from seaweedfs_tpu import __version__
+    print(f"seaweedfs-tpu {__version__}")
+    return 0
+
+
+@command("shell", "interactive admin shell against a master")
+def run_shell(args) -> int:
+    p = argparse.ArgumentParser(prog="shell")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("command", nargs="*",
+                   help="one-shot command (omit for a REPL)")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.shell import CommandError, Shell
+    sh = Shell(opts.master)
+    if opts.command:
+        try:
+            print(sh.run_command(" ".join(opts.command)), end="")
+            return 0
+        except CommandError as e:
+            if e.partial:
+                print(e.partial, end="")
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    sh.repl()
+    return 0
+
+
+@command("upload", "upload files via master assignment")
+def run_upload(args) -> int:
+    p = argparse.ArgumentParser(prog="upload")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("files", nargs="+")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.operation import operations
+    results = []
+    for path in opts.files:
+        with open(path, "rb") as f:
+            data = f.read()
+        fid = operations.upload(
+            opts.master, data, filename=os.path.basename(path),
+            collection=opts.collection, replication=opts.replication,
+            ttl=opts.ttl)
+        results.append({"fileName": os.path.basename(path),
+                        "fid": fid, "size": len(data)})
+    print(json.dumps(results, indent=2))
+    return 0
+
+
+@command("download", "download a file id to disk")
+def run_download(args) -> int:
+    p = argparse.ArgumentParser(prog="download")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-dir", default=".")
+    p.add_argument("fids", nargs="+")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.operation import operations
+    for fid in opts.fids:
+        data = operations.download(opts.master, fid)
+        out = os.path.join(opts.dir, fid.replace(",", "_"))
+        with open(out, "wb") as f:
+            f.write(data)
+        print(out)
+    return 0
+
+
+@command("delete", "delete file ids")
+def run_delete(args) -> int:
+    p = argparse.ArgumentParser(prog="delete")
+    p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("fids", nargs="+")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.operation import operations
+    for fid in opts.fids:
+        operations.delete_file(opts.master, fid)
+        print(f"deleted {fid}")
+    return 0
+
+
+@command("fix", "rebuild a volume's .idx by scanning its .dat")
+def run_fix(args) -> int:
+    """Reference weed/command/fix.go:21-100: walk every needle record in
+    the .dat and re-derive the index (tombstones for deleted flags)."""
+    p = argparse.ArgumentParser(prog="fix")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.storage import fix as fix_mod
+    base = os.path.join(
+        opts.dir,
+        (f"{opts.collection}_" if opts.collection else "")
+        + str(opts.volume_id))
+    n = fix_mod.rebuild_idx(base)
+    print(f"rebuilt {base}.idx with {n} entries")
+    return 0
+
+
+@command("export", "export a volume's needles to a tar archive")
+def run_export(args) -> int:
+    """Reference weed/command/export.go: dump live needles (name or fid
+    as the member name) to a tar stream."""
+    p = argparse.ArgumentParser(prog="export")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-o", dest="output", required=True,
+                   help="output .tar path")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.storage import fix as fix_mod
+    base = os.path.join(
+        opts.dir,
+        (f"{opts.collection}_" if opts.collection else "")
+        + str(opts.volume_id))
+    n = fix_mod.export_tar(base, opts.volume_id, opts.output)
+    print(f"exported {n} files to {opts.output}")
+    return 0
+
+
+SCAFFOLDS = {
+    "master": """\
+# master.toml — maintenance automation (reference command/scaffold.go:422-433)
+[master.maintenance]
+# shell commands the master leader runs periodically
+scripts = [
+  "lock",
+  "ec.encode -fullPercent=95 -quietFor=1h",
+  "ec.rebuild -force",
+  "ec.balance -force",
+  "volume.balance",
+  "unlock",
+]
+sleep_minutes = 17
+
+[master.sequencer]
+type = "memory"  # or "snowflake"
+""",
+    "security": """\
+# security.toml (reference command/scaffold.go [jwt.signing])
+[jwt.signing]
+key = ""             # base64 secret; empty disables write JWT
+expires_after_seconds = 10
+
+[jwt.signing.read]
+key = ""
+expires_after_seconds = 10
+""",
+    "filer": """\
+# filer.toml — metadata store selection
+[filer.options]
+recursive_delete = false
+
+[memory]
+enabled = false
+
+[sqlite]
+# the default embedded store
+enabled = true
+dbFile = "./filer.db"
+""",
+    "replication": """\
+# replication.toml (reference command/scaffold.go [source.filer]/[sink.*])
+[source.filer]
+grpcAddress = "localhost:18888"
+directory = "/buckets"
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:18888"
+directory = "/backup"
+replication = ""
+
+[sink.local]
+enabled = false
+directory = "/data/backup"
+
+[sink.s3]
+enabled = false
+endpoint = ""
+bucket = ""
+directory = ""
+""",
+    "notification": """\
+# notification.toml (reference command/scaffold.go [notification.*])
+[notification.log]
+enabled = true
+
+[notification.memory]
+enabled = false
+""",
+}
+
+
+@command("scaffold", "print an example configuration file")
+def run_scaffold(args) -> int:
+    p = argparse.ArgumentParser(prog="scaffold")
+    p.add_argument("-config", default="master",
+                   choices=sorted(SCAFFOLDS))
+    p.add_argument("-output", default="",
+                   help="write to <output>/<config>.toml instead of stdout")
+    opts = p.parse_args(args)
+    text = SCAFFOLDS[opts.config]
+    if opts.output:
+        path = os.path.join(opts.output, f"{opts.config}.toml")
+        with open(path, "w") as f:
+            f.write(text)
+        print(path)
+    else:
+        print(text, end="")
+    return 0

@@ -1,0 +1,200 @@
+"""The single-binary CLI (reference weed/command/command.go,
+weed/weed.go:37): subprocess servers, client tools, offline volume
+tools, the load generator, and graceful stop."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.storage.volume import Volume
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    # gRPC listens at port+10000, so the HTTP port must stay below 55536
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port + 10000 < 65536:
+            return port
+
+
+def run_cli(*args, timeout=60):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env)
+
+
+def spawn_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, cwd=REPO, env=env)
+
+
+def wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(url, timeout=2.0)
+            return
+        except Exception:
+            time.sleep(0.2)
+    raise TimeoutError(f"{url} never came up")
+
+
+@pytest.fixture(scope="module")
+def cli_cluster(tmp_path_factory):
+    """master + volume server as real subprocesses."""
+    tmp = tmp_path_factory.mktemp("cli")
+    mport, vport = free_port(), free_port()
+    procs = []
+    try:
+        procs.append(spawn_cli(
+            "master", "-port", str(mport),
+            "-mdir", str(tmp / "m"), "-volumeSizeLimitMB", "64"))
+        wait_http(f"http://127.0.0.1:{mport}/cluster/status")
+        procs.append(spawn_cli(
+            "volume", "-port", str(vport), "-dir", str(tmp / "v"),
+            "-max", "50",
+            "-mserver", f"127.0.0.1:{mport}", "-pulseSeconds", "0.3"))
+        wait_http(f"http://127.0.0.1:{vport}/status")
+        # wait for the heartbeat to register
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/dir/assign", timeout=2)
+                break
+            except Exception:
+                time.sleep(0.2)
+        yield {"master": f"127.0.0.1:{mport}", "tmp": tmp, "procs": procs}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_usage_lists_commands():
+    r = run_cli("help")
+    assert r.returncode == 0
+    for name in ("master", "volume", "filer", "s3", "webdav", "shell",
+                 "server", "benchmark", "upload", "download", "fix",
+                 "export", "scaffold"):
+        assert name in r.stdout
+
+
+def test_version():
+    r = run_cli("version")
+    assert r.returncode == 0 and "seaweedfs-tpu" in r.stdout
+
+
+def test_scaffold_all_configs():
+    for cfg in ("master", "security", "filer", "replication",
+                "notification"):
+        r = run_cli("scaffold", "-config", cfg)
+        assert r.returncode == 0 and "[" in r.stdout
+
+
+def test_upload_download_roundtrip(cli_cluster, tmp_path):
+    src = tmp_path / "hello.txt"
+    src.write_bytes(b"cli round trip" * 100)
+    r = run_cli("upload", "-master", cli_cluster["master"], str(src))
+    assert r.returncode == 0, r.stderr
+    fid = json.loads(r.stdout)[0]["fid"]
+    r = run_cli("download", "-master", cli_cluster["master"],
+                "-dir", str(tmp_path), fid)
+    assert r.returncode == 0, r.stderr
+    out = tmp_path / fid.replace(",", "_")
+    assert out.read_bytes() == src.read_bytes()
+    r = run_cli("delete", "-master", cli_cluster["master"], fid)
+    assert r.returncode == 0, r.stderr
+
+
+def test_shell_one_shot(cli_cluster):
+    r = run_cli("shell", "-master", cli_cluster["master"], "volume.list")
+    assert r.returncode == 0, r.stderr
+    assert "DefaultDataCenter" in r.stdout
+
+
+def test_benchmark_small(cli_cluster):
+    r = run_cli("benchmark", "-master", cli_cluster["master"],
+                "-n", "40", "-c", "4", "-size", "512", timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "requests per second" in r.stdout
+    assert "failed requests:        0" in r.stdout
+    assert "99%" in r.stdout
+
+
+def test_graceful_sigterm(tmp_path):
+    port = free_port()
+    p = spawn_cli("master", "-port", str(port), "-mdir", str(tmp_path))
+    wait_http(f"http://127.0.0.1:{port}/cluster/status")
+    p.send_signal(signal.SIGTERM)
+    rc = p.wait(timeout=15)
+    assert rc == 128 + signal.SIGTERM
+    # graceful stop persisted the sequence checkpoint
+    assert (tmp_path / "sequence.json").exists()
+
+
+def _make_volume(tmp_path, vid=7):
+    from seaweedfs_tpu.storage.needle import Needle
+    v = Volume(str(tmp_path), "", vid)
+    fids = {}
+    for i in range(1, 20):
+        n = Needle(id=i, cookie=0x1234, data=f"needle-{i}".encode() * 5,
+                   name=f"file{i}".encode())
+        v.write_needle(n)
+        fids[i] = bytes(n.data)
+    v.delete_needle(Needle(id=5, cookie=0x1234))
+    del fids[5]
+    v.close()
+    return fids
+
+
+def test_fix_rebuilds_idx(tmp_path):
+    fids = _make_volume(tmp_path)
+    idx = tmp_path / "7.idx"
+    good = idx.read_bytes()
+    idx.unlink()
+    r = run_cli("fix", "-dir", str(tmp_path), "-volumeId", "7")
+    assert r.returncode == 0, r.stderr
+    assert idx.exists()
+    # reload: every live needle readable, deleted one gone
+    from seaweedfs_tpu.storage.needle import Needle
+    v = Volume(str(tmp_path), "", 7)
+    for i, data in fids.items():
+        assert bytes(v.read_needle(Needle(id=i, cookie=0x1234)).data) == data
+    assert v.nm.get(5) is None or v.nm.get(5).size < 0
+    v.close()
+    assert len(good) >= len(idx.read_bytes()) > 0
+
+
+def test_export_tar(tmp_path):
+    fids = _make_volume(tmp_path)
+    out = tmp_path / "vol7.tar"
+    r = run_cli("export", "-dir", str(tmp_path), "-volumeId", "7",
+                "-o", str(out))
+    assert r.returncode == 0, r.stderr
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        assert len(names) == len(fids)
+        assert "file1" in names and "file5" not in names
+        got = tar.extractfile("file3").read()
+        assert got == fids[3]
