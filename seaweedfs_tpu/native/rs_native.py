@@ -14,12 +14,45 @@ import numpy as np
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "librs_cpu.so")
 _lib = None
+_build_attempted = False
+
+
+def _try_build() -> None:
+    """Build librs_cpu.so from source on first use if it is missing.
+
+    The .so is not checked in (it's a build artifact); the image always
+    has g++, so a fresh checkout self-builds the native CRC/GF kernels
+    instead of silently degrading to the pure-Python fallbacks. Build
+    failures are swallowed — callers fall back as before.
+    """
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    src_dir = os.path.dirname(__file__)
+    if not os.path.exists(os.path.join(src_dir, "rs_cpu.cpp")):
+        return
+    import subprocess
+    try:
+        subprocess.run(
+            ["make", "-C", src_dir, "-s"],
+            check=False, timeout=120,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except Exception:
+        pass
 
 
 def _load():
     global _lib
+    if _lib is None and not os.path.exists(_LIB_PATH):
+        _try_build()
     if _lib is None and os.path.exists(_LIB_PATH):
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # e.g. another process is mid-build; fall back this call,
+            # retry on the next one
+            return None
         lib.gf_linear.restype = None
         lib.gf_linear.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),  # matrix [out, k]

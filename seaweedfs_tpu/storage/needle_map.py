@@ -114,8 +114,13 @@ class NeedleMap:
             return prev[1]
 
     def _append_entry(self, key: int, offset: int, size: int) -> None:
+        # buffered; the volume's group-commit batch (or sync()) flushes —
+        # one flush per batch instead of one syscall per entry
         if self._index_file is not None:
             self._index_file.write(idx_codec.entry_to_bytes(key, offset, size))
+
+    def flush(self) -> None:
+        if self._index_file is not None:
             self._index_file.flush()
 
     def sync(self) -> None:

@@ -7,20 +7,26 @@ empty needle appended to .dat + a size=-1 .idx entry), TTL expiry on
 read, torn-tail truncation at load.
 
 Python is fine here: the hot byte work (CRC) is native, and appends are
-single `write` syscalls. The reference's async group-commit worker
-(volume_read_write.go:331-405) is replaced by a per-volume lock; the
-group-commit batching optimization can layer on later without format
-changes.
+single `write` syscalls. Writes go through a per-volume group-commit
+worker mirroring the reference's async write path
+(volume_read_write.go:331-405): a single writer thread drains up to
+128 queued requests / 4MB per batch, stages all appends into one
+buffer, issues one write syscall + one flush (+ one fsync if any
+request asked for it), then publishes index entries and wakes waiters.
+A physical write error truncates the .dat back to the batch start
+before failing the batch (truncate-on-error, :385-399).
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
 from typing import Optional
 
 from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.storage.needle import (
     Needle, NeedleError, CookieMismatch, actual_size, VERSION3,
 )
@@ -29,15 +35,124 @@ from seaweedfs_tpu.storage.superblock import SuperBlock, ReplicaPlacement, TTL
 from seaweedfs_tpu.storage import idx as idx_codec
 
 
+_log = wlog.logger("storage.volume")
+
+
 class VolumeError(Exception):
     pass
+
+
+class _WriteRequest:
+    """One queued write/delete riding the group-commit worker."""
+
+    __slots__ = ("kind", "needle", "fsync", "event", "result", "error")
+
+    def __init__(self, kind: str, needle: "Needle", fsync: bool = False):
+        self.kind = kind
+        self.needle = needle
+        self.fsync = fsync
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+    def complete(self, result=None, error: Optional[BaseException] = None):
+        self.result = result
+        self.error = error
+        self.event.set()
+
+    def wait(self):
+        # indefinite, like the reference's channel receive: a timeout
+        # here would abandon a request that the worker later commits
+        # anyway (ghost write). The worker always completes every
+        # request, including on stop().
+        self.event.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _GroupCommitWriter:
+    """Single writer thread per volume with batched group commit.
+
+    Mirrors the reference's asyncWrite worker
+    (weed/storage/volume_read_write.go:331-405): requests queue up while
+    a batch is in flight; each drain takes at most MAX_BATCH_REQS
+    requests or MAX_BATCH_BYTES of payload, stages every append into one
+    contiguous buffer, and commits it with a single write()+flush()
+    (+fsync if any request requires it). Index entries are published
+    only after the bytes are durably staged, so readers (which take the
+    volume lock) never observe an index entry pointing at unwritten
+    data. On a physical write error the .dat is truncated back to the
+    batch start offset and every request in the batch fails.
+    """
+
+    MAX_BATCH_REQS = 128
+    MAX_BATCH_BYTES = 4 * 1024 * 1024
+
+    def __init__(self, volume: "Volume"):
+        self.volume = volume
+        self._queue: collections.deque[_WriteRequest] = collections.deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"vol-{volume.id}-writer", daemon=True)
+        self._thread.start()
+
+    def backlog(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req: _WriteRequest):
+        with self._cond:
+            if self._stopped:
+                raise VolumeError(
+                    f"volume {self.volume.id}: writer is stopped")
+            self._queue.append(req)
+            self._cond.notify()
+        return req.wait()
+
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        self._thread.join(timeout=10)
+        # fail anything still queued
+        while self._queue:
+            self._queue.popleft().complete(
+                error=VolumeError("volume closed"))
+
+    def _drain(self) -> Optional[list[_WriteRequest]]:
+        with self._cond:
+            while not self._queue and not self._stopped:
+                self._cond.wait()
+            if not self._queue:
+                return None
+            batch, payload = [], 0
+            while self._queue and len(batch) < self.MAX_BATCH_REQS and \
+                    payload < self.MAX_BATCH_BYTES:
+                req = self._queue.popleft()
+                batch.append(req)
+                payload += len(req.needle.data)
+            return batch
+
+    def _run(self):
+        while True:
+            batch = self._drain()
+            if batch is None:
+                return
+            try:
+                self.volume._apply_batch(batch)
+            except BaseException as e:  # never kill the worker thread
+                for req in batch:
+                    if not req.event.is_set():
+                        req.complete(error=e)
 
 
 class Volume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  replica_placement: ReplicaPlacement = ReplicaPlacement(),
                  ttl: TTL = TTL.empty(),
-                 create_if_missing: bool = True):
+                 create_if_missing: bool = True,
+                 async_write: bool = True):
         self.dir = dirname
         self.collection = collection
         self.id = vid
@@ -46,6 +161,9 @@ class Volume:
         self.last_append_at_ns = 0
         self.last_modified_ts = 0
         self._lock = threading.RLock()
+        self.async_write = async_write
+        self._writer: Optional[_GroupCommitWriter] = None
+        self._writer_lock = threading.Lock()
         base = self.file_name()
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
@@ -126,69 +244,196 @@ class Volume:
     # -- write path ----------------------------------------------------------
 
     def write_needle(self, n: Needle, fsync: bool = False) -> tuple[int, int]:
-        """Append a needle; returns (offset, size). Cookie-checked overwrite."""
+        """Append a needle; returns (offset, size). Cookie-checked overwrite.
+
+        Routing is adaptive: fsync'd writes (and anything arriving while
+        the worker has a backlog) ride the group-commit worker so many
+        requests share one fsync; uncontended non-durable writes take
+        the direct locked path, which is cheaper than a thread handoff.
+        Either way the call blocks until the bytes are committed, so
+        callers observe synchronous semantics.
+        """
         if len(n.data) == 0:
             raise VolumeError(
                 "zero-byte writes are not storable (indistinguishable from "
                 "a delete marker); reject at the write path")
-        with self._lock:
-            if self.read_only:
-                raise VolumeError(f"volume {self.id} is read-only")
-            if n.ttl is None or n.ttl.is_empty:
-                if not self.ttl.is_empty:
-                    n.ttl = self.ttl
-            existing = self.nm.get(n.id)
-            if existing is not None:
-                old = self._read_needle_at(existing.offset, existing.size,
-                                           check_crc=False)
-                if old.cookie != n.cookie:
-                    raise CookieMismatch(
-                        f"needle {n.id:x}: cookie mismatch {n.cookie:08x}")
-            n.append_at_ns = time.time_ns()
-            blob = n.to_bytes(self.version)
-            offset = self._append_blob(blob, fsync)
-            self.last_append_at_ns = n.append_at_ns
-            if n.last_modified > self.last_modified_ts:
-                self.last_modified_ts = n.last_modified
-            self.nm.put(n.id, offset, n.size)
-            return offset, n.size
-
-    def _append_blob(self, blob: bytes, fsync: bool = False) -> int:
-        self._dat.seek(0, os.SEEK_END)
-        offset = self._dat.tell()
-        if offset % t.NEEDLE_PADDING != 0:
-            pad = t.NEEDLE_PADDING - offset % t.NEEDLE_PADDING
-            self._dat.write(b"\x00" * pad)
-            offset += pad
-        if offset + len(blob) > t.MAX_POSSIBLE_VOLUME_SIZE:
-            raise VolumeError(f"volume {self.id} exceeds max size")
-        self._dat.write(blob)
-        self._dat.flush()
-        if fsync:
-            os.fsync(self._dat.fileno())
-        return offset
+        req = _WriteRequest("write", n, fsync)
+        if self._use_worker(fsync):
+            return self._get_writer().submit(req)
+        self._apply_batch([req])
+        return req.wait()
 
     def delete_needle(self, n: Needle) -> int:
         """Tombstone a needle; returns freed size (0 if absent)."""
+        req = _WriteRequest("delete", n)
+        if self._use_worker(False):
+            return self._get_writer().submit(req)
+        self._apply_batch([req])
+        return req.wait()
+
+    def _use_worker(self, fsync: bool) -> bool:
+        if not self.async_write:
+            return False
+        if fsync:
+            return True
+        w = self._writer
+        return w is not None and w.backlog() > 0
+
+    def _get_writer(self) -> _GroupCommitWriter:
+        with self._writer_lock:
+            if self._writer is None:
+                self._writer = _GroupCommitWriter(self)
+            return self._writer
+
+    def _lookup_for_batch(self, key: int, pending: dict):
+        """Intra-batch index view: staged-but-unpublished entries first,
+        then the real needle map. Returns (offset, size) or None."""
+        if key in pending:
+            return pending[key]
+        nv = self.nm.get(key)
+        if nv is None or not t.size_is_valid(nv.size):
+            return None
+        return (nv.offset, nv.size)
+
+    def _read_old_needle(self, offset: int, size: int, batch_start: int,
+                         buf: bytearray) -> Needle:
+        """Read a pre-existing needle for a cookie check. If it was
+        staged earlier in the same batch it lives in `buf`, not on disk."""
+        if offset >= batch_start:
+            start = offset - batch_start
+            blob = bytes(buf[start:start + actual_size(size, self.version)])
+            return Needle.from_bytes(blob, self.version, check_crc=False)
+        return self._read_needle_at(offset, size, check_crc=False)
+
+    def _apply_batch(self, batch: list[_WriteRequest]) -> None:
+        """Commit a batch of write/delete requests with one physical
+        append. See _GroupCommitWriter for the protocol."""
         with self._lock:
-            if self.read_only:
-                raise VolumeError(f"volume {self.id} is read-only")
-            nv = self.nm.get(n.id)
-            if nv is None:
-                return 0
-            if n.cookie:
-                old = self._read_needle_at(nv.offset, nv.size, check_crc=False)
-                if old.cookie != n.cookie:
-                    raise CookieMismatch(
-                        f"needle {n.id:x}: delete cookie mismatch")
-            freed = nv.size
-            marker = Needle(id=n.id, cookie=n.cookie, data=b"")
-            marker.append_at_ns = time.time_ns()
-            blob = marker.to_bytes(self.version)
-            offset = self._append_blob(blob)
-            self.last_append_at_ns = marker.append_at_ns
-            self.nm.delete(n.id, offset)
-            return freed
+            self._dat.seek(0, os.SEEK_END)
+            batch_start = self._dat.tell()
+            buf = bytearray()
+            staged = []  # (req, publish_fn, result)
+            pending: dict[int, Optional[tuple[int, int]]] = {}
+            any_fsync = False
+            for req in batch:
+                try:
+                    if self.read_only:
+                        raise VolumeError(f"volume {self.id} is read-only")
+                    if req.kind == "write":
+                        staged.append(self._stage_write(
+                            req, batch_start, buf, pending))
+                        any_fsync = any_fsync or req.fsync
+                    else:
+                        item = self._stage_delete(
+                            req, batch_start, buf, pending)
+                        if item is None:
+                            req.complete(result=0)
+                        else:
+                            staged.append(item)
+                except BaseException as e:
+                    req.complete(error=e)
+            if buf:
+                try:
+                    self._dat.seek(0, os.SEEK_END)
+                    self._dat.write(buf)
+                    self._dat.flush()
+                    if any_fsync:
+                        os.fsync(self._dat.fileno())
+                except OSError as e:
+                    # truncate-on-error: roll the .dat back to the batch
+                    # start so no index entry ever points at torn bytes
+                    # (reference volume_read_write.go:385-399)
+                    try:
+                        self._dat.truncate(batch_start)
+                    except OSError:
+                        pass
+                    err = VolumeError(
+                        f"volume {self.id}: batch write failed: {e}")
+                    for req, _, _ in staged:
+                        req.complete(error=err)
+                    return
+            for req, publish, result in staged:
+                try:
+                    publish()
+                except OSError as e:
+                    req.complete(error=VolumeError(
+                        f"volume {self.id}: index publish failed: {e}"))
+                    continue
+                req.complete(result=result)
+            try:
+                # .idx entries are buffered; flush once per batch. A
+                # failure here (e.g. ENOSPC) leaves the bytes buffered —
+                # the in-memory map is consistent and a later flush or
+                # sync() retries, so acked writes stay readable.
+                self.nm.flush()
+            except OSError as e:
+                _log.warning("volume %d: idx flush failed (will retry "
+                             "on next batch/sync): %s", self.id, e)
+
+    def _stage_write(self, req: _WriteRequest, batch_start: int,
+                     buf: bytearray, pending: dict):
+        n = req.needle
+        if n.ttl is None or n.ttl.is_empty:
+            if not self.ttl.is_empty:
+                n.ttl = self.ttl
+        existing = self._lookup_for_batch(n.id, pending)
+        if existing is not None:
+            old = self._read_old_needle(existing[0], existing[1],
+                                        batch_start, buf)
+            if old.cookie != n.cookie:
+                raise CookieMismatch(
+                    f"needle {n.id:x}: cookie mismatch {n.cookie:08x}")
+        n.append_at_ns = time.time_ns()
+        blob = n.to_bytes(self.version)
+        offset = self._stage_blob(batch_start, buf, blob)
+        pending[n.id] = (offset, n.size)
+
+        def publish(n=n, offset=offset):
+            self.nm.put(n.id, offset, n.size)
+            if n.append_at_ns > self.last_append_at_ns:
+                self.last_append_at_ns = n.append_at_ns
+            if n.last_modified > self.last_modified_ts:
+                self.last_modified_ts = n.last_modified
+
+        return req, publish, (offset, n.size)
+
+    def _stage_delete(self, req: _WriteRequest, batch_start: int,
+                      buf: bytearray, pending: dict):
+        n = req.needle
+        existing = self._lookup_for_batch(n.id, pending)
+        if existing is None:
+            return None
+        if n.cookie:
+            old = self._read_old_needle(existing[0], existing[1],
+                                        batch_start, buf)
+            if old.cookie != n.cookie:
+                raise CookieMismatch(
+                    f"needle {n.id:x}: delete cookie mismatch")
+        freed = existing[1]
+        marker = Needle(id=n.id, cookie=n.cookie, data=b"")
+        marker.append_at_ns = time.time_ns()
+        blob = marker.to_bytes(self.version)
+        offset = self._stage_blob(batch_start, buf, blob)
+        pending[n.id] = None
+
+        def publish(marker=marker, offset=offset):
+            self.nm.delete(marker.id, offset)
+            if marker.append_at_ns > self.last_append_at_ns:
+                self.last_append_at_ns = marker.append_at_ns
+
+        return req, publish, freed
+
+    def _stage_blob(self, batch_start: int, buf: bytearray,
+                    blob: bytes) -> int:
+        tail = batch_start + len(buf)
+        if tail % t.NEEDLE_PADDING != 0:
+            pad = t.NEEDLE_PADDING - tail % t.NEEDLE_PADDING
+            buf += b"\x00" * pad
+            tail += pad
+        if tail + len(blob) > t.MAX_POSSIBLE_VOLUME_SIZE:
+            raise VolumeError(f"volume {self.id} exceeds max size")
+        buf += blob
+        return tail
 
     # -- read path -----------------------------------------------------------
 
@@ -283,6 +528,10 @@ class Volume:
         self.nm.sync()
 
     def close(self) -> None:
+        with self._writer_lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.stop()
         with self._lock:
             self._dat.flush()
             self._dat.close()
