@@ -105,6 +105,23 @@ def _volume_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _storage_backend_conf() -> dict:
+    """Flatten master.toml's [storage.backend.<scheme>.<id>] sections to
+    {"scheme.id": props} (reference backend.go LoadConfiguration)."""
+    from seaweedfs_tpu.util import config as config_mod
+    conf = config_mod.load_configuration("master")
+    tree = conf.get("storage.backend") or {}
+    flat = {}
+    for scheme, ids in tree.items():
+        if not isinstance(ids, dict):
+            continue
+        for ident, props in ids.items():
+            if isinstance(props, dict) and props.get("enabled", True):
+                flat[f"{scheme}.{ident}"] = {
+                    k: v for k, v in props.items() if k != "enabled"}
+    return flat
+
+
 def _build_volume(opts):
     from seaweedfs_tpu.server.volume import VolumeServer
     dirs = _split_dirs(opts.dir)
@@ -116,7 +133,8 @@ def _build_volume(opts):
         public_url=opts.public_url, data_center=opts.data_center,
         rack=opts.rack, max_volume_counts=maxes,
         pulse_seconds=opts.pulse_seconds, ec_encoder=opts.ec_encoder,
-        compaction_mbps=opts.compaction_mbps)
+        compaction_mbps=opts.compaction_mbps,
+        storage_backends=_storage_backend_conf())
 
 
 @command("volume", "start a volume server (data plane)")

@@ -156,6 +156,16 @@ sleep_minutes = 17
 
 [master.sequencer]
 type = "memory"  # or "snowflake"
+
+# cloud-tier targets for `volume.tier.upload` (reference scaffold.go
+# [storage.backend.s3.default]); volume servers read this section too
+#[storage.backend.s3.default]
+#enabled = true
+#endpoint = "127.0.0.1:8333"
+#bucket = "volume_tier"
+#access_key = ""
+#secret_key = ""
+#region = "us-east-1"
 """,
     "security": """\
 # security.toml (reference command/scaffold.go [jwt.signing])
@@ -229,4 +239,55 @@ def run_scaffold(args) -> int:
         print(path)
     else:
         print(text, end="")
+    return 0
+
+
+@command("backup", "incrementally back up a volume from a volume server")
+def run_backup(args) -> int:
+    """Reference weed/command/backup.go: keep a local replica of one
+    volume in sync with the cluster. The first run copies everything
+    (an incremental from ns=0); later runs ship only the delta after
+    the local replica's newest appendAtNs. A compaction-revision
+    mismatch or a local replica that is AHEAD of the source forces a
+    full resync (backup.go step 0)."""
+    p = argparse.ArgumentParser(prog="backup")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-server", default="127.0.0.1:9333",
+                   help="master url")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.operation.operations import lookup
+    from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+    from seaweedfs_tpu.storage import volume_backup
+    from seaweedfs_tpu.storage.volume import Volume
+
+    locations = lookup(opts.server, opts.volume_id, opts.collection)
+    if not locations:
+        print(f"volume {opts.volume_id} not found via {opts.server}",
+              file=sys.stderr)
+        return 1
+    src = volume_stub(locations[0])
+    status = src.VolumeSyncStatus(
+        volume_server_pb2.VolumeSyncStatusRequest(volume_id=opts.volume_id))
+
+    v = Volume(opts.dir, opts.collection or status.collection,
+               opts.volume_id)
+    if v.super_block.compaction_revision != status.compact_revision or \
+            v.content_size > status.tail_offset:
+        # source was compacted (or we are somehow ahead): full resync
+        print(f"volume {opts.volume_id}: full resync "
+              f"(local rev {v.super_block.compaction_revision} size "
+              f"{v.content_size}, remote rev {status.compact_revision} "
+              f"size {status.tail_offset})")
+        v.destroy()
+        v = Volume(opts.dir, opts.collection or status.collection,
+                   opts.volume_id)
+        v.super_block.compaction_revision = status.compact_revision
+        v._dat.write_at(v.super_block.to_bytes(), 0)
+    appended = volume_backup.incremental_backup(v, src)
+    total = v.content_size
+    v.close()
+    print(f"volume {opts.volume_id}: +{appended} bytes (local .dat now "
+          f"{total} bytes)")
     return 0

@@ -34,11 +34,15 @@ from seaweedfs_tpu.operation.file_id import parse_fid
 from seaweedfs_tpu.pb import (master_pb2, master_stub, volume_server_pb2,
                               volume_stub)
 from seaweedfs_tpu.server import convert
+from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage import volume_backup, volume_tier
+from seaweedfs_tpu.storage.backend import BackendError
 from seaweedfs_tpu.storage.needle import (FLAG_IS_COMPRESSED, CookieMismatch,
                                           Needle, NeedleError)
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.superblock import TTL
+from seaweedfs_tpu.storage.volume import VolumeError
 
 log = wlog.logger("volume")
 
@@ -52,7 +56,13 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "",
                  rack: str = "", max_volume_counts: Optional[List[int]] = None,
                  pulse_seconds: float = 5.0, ec_encoder: str = "auto",
-                 compaction_mbps: float = 0.0):
+                 compaction_mbps: float = 0.0,
+                 storage_backends: Optional[dict] = None):
+        if storage_backends:
+            # cloud-tier targets, e.g. {"s3.default": {...}} (reference
+            # master.toml [storage.backend.s3.default])
+            from seaweedfs_tpu.storage import backend as _bk
+            _bk.load_configuration(storage_backends)
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -170,7 +180,11 @@ class VolumeServer:
         return volume_server_pb2.VolumeMarkReadonlyResponse()
 
     def VolumeMarkWritable(self, request, context):
-        if not self.store.mark_volume_writable(request.volume_id):
+        try:
+            found = self.store.mark_volume_writable(request.volume_id)
+        except VolumeError as e:  # cloud-tiered volumes stay sealed
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        if not found:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"volume {request.volume_id} not found")
         self.trigger_heartbeat()
@@ -375,6 +389,147 @@ class VolumeServer:
                     ignore_source_file_not_found=ignore_missing)):
                 f.write(resp.file_content)
         os.replace(tmp, dest_path)
+
+    # -- gRPC: sync status / incremental copy / tail ---------------------------
+
+    def VolumeSyncStatus(self, request, context):
+        """Handshake for followers (reference volume_backup.go:19-33)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        st = volume_backup.sync_status(v)
+        return volume_server_pb2.VolumeSyncStatusResponse(
+            volume_id=st["volume_id"], collection=st["collection"],
+            replication=st["replication"], ttl=st["ttl"],
+            tail_offset=st["tail_offset"],
+            compact_revision=st["compact_revision"],
+            idx_file_size=st["idx_file_size"])
+
+    def VolumeIncrementalCopy(self, request, context):
+        """Stream raw .dat bytes appended after since_ns
+        (reference server/volume_grpc_copy_incremental.go)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        offset, is_last = volume_backup.binary_search_by_append_at_ns(
+            v, request.since_ns)
+        if is_last:
+            return
+        for chunk in volume_backup.read_dat_range(v, offset):
+            yield volume_server_pb2.VolumeIncrementalCopyResponse(
+                file_content=chunk)
+
+    def VolumeTailSender(self, request, context):
+        """Stream needles appended after since_ns; keep following until
+        the tail stays quiet for idle_timeout_seconds (0 = follow
+        forever; reference volume_grpc_tail.go:17-64)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        last_ns = request.since_ns
+        draining = request.idle_timeout_seconds
+        while True:
+            if not context.is_active():
+                # client went away: don't pin a gRPC worker thread
+                # forever on an idle follow-mode stream
+                return
+            progressed = False
+            offset, is_last = volume_backup.binary_search_by_append_at_ns(
+                v, last_ns)
+            if not is_last:
+                for off, n in volume_backup.scan_dat_from(v, offset):
+                    blob = n.to_bytes(v.version)
+                    yield volume_server_pb2.VolumeTailSenderResponse(
+                        needle_header=blob[:t.NEEDLE_HEADER_SIZE],
+                        needle_body=blob[t.NEEDLE_HEADER_SIZE:])
+                    if n.append_at_ns > last_ns:
+                        last_ns = n.append_at_ns
+                        progressed = True
+            if request.idle_timeout_seconds == 0:
+                time.sleep(1)
+                continue
+            if progressed:
+                draining = request.idle_timeout_seconds
+            else:
+                draining -= 1
+                if draining <= 0:
+                    yield volume_server_pb2.VolumeTailSenderResponse(
+                        is_last_chunk=True)
+                    return
+            time.sleep(1)
+
+    def VolumeTailReceiver(self, request, context):
+        """Pull a tail stream from source_volume_server and replay it
+        into the local replica (reference volume_grpc_tail.go:80-94)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        src = volume_stub(request.source_volume_server)
+        for resp in src.VolumeTailSender(
+                volume_server_pb2.VolumeTailSenderRequest(
+                    volume_id=request.volume_id,
+                    since_ns=request.since_ns,
+                    idle_timeout_seconds=request.idle_timeout_seconds)):
+            if resp.is_last_chunk:
+                break
+            blob = bytes(resp.needle_header) + bytes(resp.needle_body)
+            n = Needle.from_bytes(blob, v.version, check_crc=False)
+            if len(n.data) == 0:
+                v.delete_needle(n)
+            else:
+                v.write_needle(n)
+        return volume_server_pb2.VolumeTailReceiverResponse()
+
+    # -- gRPC: cloud tier ------------------------------------------------------
+
+    def VolumeTierMoveDatToRemote(self, request, context):
+        """Upload a sealed volume's .dat to the named storage backend
+        (reference volume_grpc_tier_upload.go)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        total = max(v.content_size, 1)
+        progress_state = {"sent": 0}
+
+        def progress(nbytes):
+            progress_state["sent"] += nbytes
+
+        try:
+            volume_tier.move_dat_to_remote(
+                v, request.destination_backend_name,
+                keep_local=request.keep_local_dat_file,
+                progress=progress)
+        except (VolumeError, BackendError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield volume_server_pb2.VolumeTierMoveDatToRemoteResponse(
+            processed=progress_state["sent"],
+            processed_percentage=100.0 * progress_state["sent"] / total)
+
+    def VolumeTierMoveDatFromRemote(self, request, context):
+        """Download a tiered volume's .dat back to local disk
+        (reference volume_grpc_tier_download.go)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        state = {"done": 0}
+
+        def progress(nbytes):
+            state["done"] += nbytes
+
+        try:
+            total = volume_tier.move_dat_from_remote(
+                v, keep_remote=request.keep_remote_dat_file,
+                progress=progress)
+        except (VolumeError, BackendError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield volume_server_pb2.VolumeTierMoveDatFromRemoteResponse(
+            processed=total, processed_percentage=100.0)
 
     # -- gRPC: erasure coding --------------------------------------------------
 

@@ -246,3 +246,45 @@ def volume_unmount(env: CommandEnv, argv: List[str], out) -> None:
     env.volume_server(args.node).VolumeUnmount(
         volume_server_pb2.VolumeUnmountRequest(volume_id=args.volumeId))
     out.write(f"volume {args.volumeId}: unmounted on {args.node}\n")
+
+
+@command("volume.tier.upload", "move a sealed volume's .dat to a storage "
+                               "backend")
+def volume_tier_upload(env: CommandEnv, argv: List[str], out) -> None:
+    """Reference: weed/shell/command_volume_tier_upload.go — mark the
+    volume readonly, then VolumeTierMoveDatToRemote on each holder."""
+    p = argparse.ArgumentParser(prog="volume.tier.upload")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-dest", required=True,
+                   help="backend name, e.g. s3.default / memory.test")
+    p.add_argument("-keepLocalDatFile", action="store_true")
+    args = p.parse_args(argv)
+    for url in env.lookup(args.volumeId):
+        env.volume_server(url).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(
+                volume_id=args.volumeId))
+        for resp in env.volume_server(url).VolumeTierMoveDatToRemote(
+                volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
+                    volume_id=args.volumeId,
+                    destination_backend_name=args.dest,
+                    keep_local_dat_file=args.keepLocalDatFile)):
+            out.write(f"volume {args.volumeId} on {url}: "
+                      f"{resp.processed} bytes -> {args.dest} "
+                      f"({resp.processed_percentage:.0f}%)\n")
+
+
+@command("volume.tier.download", "bring a cloud-tiered volume's .dat back "
+                                 "to local disk")
+def volume_tier_download(env: CommandEnv, argv: List[str], out) -> None:
+    """Reference: weed/shell/command_volume_tier_download.go."""
+    p = argparse.ArgumentParser(prog="volume.tier.download")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-keepRemoteDatFile", action="store_true")
+    args = p.parse_args(argv)
+    for url in env.lookup(args.volumeId):
+        for resp in env.volume_server(url).VolumeTierMoveDatFromRemote(
+                volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
+                    volume_id=args.volumeId,
+                    keep_remote_dat_file=args.keepRemoteDatFile)):
+            out.write(f"volume {args.volumeId} on {url}: "
+                      f"{resp.processed} bytes restored\n")

@@ -1,0 +1,325 @@
+"""Backend storage abstraction: where a volume's .dat bytes live.
+
+Mirrors the reference SPI (weed/storage/backend/backend.go:15-74):
+
+- ``BackendStorageFile`` — positional-IO handle for one volume data
+  file (ReadAt/WriteAt/Truncate/Sync/GetStat).  ``DiskFile`` is the
+  local implementation (os.pread/os.pwrite — thread-safe, no shared
+  seek pointer); ``RemoteFile`` serves reads for a cloud-tiered volume
+  straight from an object store (reference
+  backend/s3_backend/s3_sessions.go + s3_backend.go ranged reads).
+- ``BackendStorage`` — one configured object-store target that sealed
+  volume files can be moved to (reference ``BackendStorage`` interface:
+  CopyFile/DownloadFile/DeleteFile).  Instances are registered under
+  ``scheme.id`` names exactly like the reference's
+  ``[storage.backend.s3.default]`` master config sections
+  (backend.go:48-74).
+
+The in-process ``MemoryBackendStorage`` stands in for S3 in tests; the
+S3-compatible implementation lives in storage/backend_s3.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Optional
+
+
+class BackendError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# BackendStorageFile: positional IO on one volume data file
+# ---------------------------------------------------------------------------
+
+
+class BackendStorageFile:
+    """Positional-IO interface over a volume's data bytes
+    (reference backend/backend.go:15-23)."""
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        raise NotImplementedError
+
+    def write_at(self, data, offset: int) -> int:
+        raise NotImplementedError
+
+    def truncate(self, size: int) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def is_remote(self) -> bool:
+        return False
+
+
+class DiskFile(BackendStorageFile):
+    """Local file via pread/pwrite — no shared seek pointer, so readers
+    never race the writer for the fd position (the reference gets this
+    from Go's ReadAt/WriteAt contracts)."""
+
+    def __init__(self, path: str, create: bool = False):
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self._fd = os.open(path, flags)
+        self._path = path
+        self._size = os.fstat(self._fd).st_size
+        self._size_lock = threading.Lock()
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        return os.pread(self._fd, size, offset)
+
+    def write_at(self, data, offset: int) -> int:
+        # pwrite may return a short count (e.g. ENOSPC mid-write); loop
+        # so callers get all-or-exception — the volume's
+        # truncate-on-error path depends on partial writes raising
+        view = memoryview(bytes(data) if not isinstance(
+            data, (bytes, bytearray, memoryview)) else data)
+        total = len(view)
+        written = 0
+        while written < total:
+            n = os.pwrite(self._fd, view[written:], offset + written)
+            if n <= 0:
+                raise OSError(
+                    f"pwrite returned {n} at {offset + written} "
+                    f"({self._path})")
+            written += n
+            with self._size_lock:
+                if offset + written > self._size:
+                    self._size = offset + written
+        return written
+
+    def truncate(self, size: int) -> None:
+        os.ftruncate(self._fd, size)
+        with self._size_lock:
+            self._size = size
+
+    def sync(self) -> None:
+        os.fsync(self._fd)
+
+    def size(self) -> int:
+        return self._size
+
+    def name(self) -> str:
+        return self._path
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            os.close(self._fd)
+            self._fd = -1
+
+
+class RemoteFile(BackendStorageFile):
+    """Read-only view of a cloud-tiered volume .dat: every read_at is a
+    ranged GET against the owning BackendStorage (reference
+    s3_backend.go ReadAt). Writes are rejected — tiered volumes are
+    sealed."""
+
+    def __init__(self, backend: "BackendStorage", key: str, size: int):
+        self.backend = backend
+        self.key = key
+        self._size = size
+
+    def read_at(self, size: int, offset: int) -> bytes:
+        return self.backend.read_range(self.key, offset, size)
+
+    def write_at(self, data, offset: int) -> int:
+        raise BackendError(f"{self.name()}: tiered volume is read-only")
+
+    def truncate(self, size: int) -> None:
+        raise BackendError(f"{self.name()}: tiered volume is read-only")
+
+    def sync(self) -> None:
+        pass
+
+    def size(self) -> int:
+        return self._size
+
+    def name(self) -> str:
+        return f"{self.backend.name}:{self.key}"
+
+    def close(self) -> None:
+        pass
+
+    @property
+    def is_remote(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# BackendStorage: a configured object-store target
+# ---------------------------------------------------------------------------
+
+
+class BackendStorage:
+    """One object-store target for sealed volume files
+    (reference backend/backend.go:32-46)."""
+
+    name: str = ""
+
+    def copy_file(self, local_path: str, key: str,
+                  progress: Optional[Callable[[int], None]] = None) -> int:
+        """Upload local_path under key; returns total bytes."""
+        raise NotImplementedError
+
+    def download_file(self, key: str, local_path: str,
+                      progress: Optional[Callable[[int], None]] = None) -> int:
+        """Download key to local_path; returns total bytes."""
+        raise NotImplementedError
+
+    def read_range(self, key: str, offset: int, length: int) -> bytes:
+        raise NotImplementedError
+
+    def delete_file(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class MemoryBackendStorage(BackendStorage):
+    """In-process object store — the test stand-in for S3 (keeps tier
+    and backup tests hermetic; the real S3 backend shares the SPI)."""
+
+    def __init__(self, name: str = "memory.default"):
+        self.name = name
+        self._objects: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def copy_file(self, local_path, key, progress=None):
+        with open(local_path, "rb") as f:
+            data = f.read()
+        with self._lock:
+            self._objects[key] = data
+        if progress:
+            progress(len(data))
+        return len(data)
+
+    def download_file(self, key, local_path, progress=None):
+        with self._lock:
+            data = self._objects.get(key)
+        if data is None:
+            raise BackendError(f"{self.name}: no object {key}")
+        with open(local_path, "wb") as f:
+            f.write(data)
+        if progress:
+            progress(len(data))
+        return len(data)
+
+    def read_range(self, key, offset, length):
+        with self._lock:
+            data = self._objects.get(key)
+        if data is None:
+            raise BackendError(f"{self.name}: no object {key}")
+        return data[offset:offset + length]
+
+    def delete_file(self, key):
+        with self._lock:
+            self._objects.pop(key, None)
+
+    def object_size(self, key) -> Optional[int]:
+        with self._lock:
+            data = self._objects.get(key)
+        return None if data is None else len(data)
+
+
+# ---------------------------------------------------------------------------
+# Registry (reference backend.go:48-74 LoadConfiguration / factory map)
+# ---------------------------------------------------------------------------
+
+_factories: Dict[str, Callable[[str, dict], BackendStorage]] = {}
+_backends: Dict[str, BackendStorage] = {}
+_registry_lock = threading.Lock()
+
+
+def register_backend_factory(scheme: str,
+                             factory: Callable[[str, dict], BackendStorage]):
+    _factories[scheme] = factory
+
+
+def load_configuration(conf: dict) -> None:
+    """conf maps backend name -> properties, e.g.
+    ``{"s3.default": {"endpoint": ..., "bucket": ...},
+       "memory.test": {}}``; the scheme is the name up to the first dot
+    (reference master.toml [storage.backend.<scheme>.<id>])."""
+    for name, props in (conf or {}).items():
+        scheme = name.split(".", 1)[0]
+        factory = _factories.get(scheme)
+        if factory is None:
+            raise BackendError(f"unknown storage backend scheme {scheme!r}")
+        register_backend(factory(name, props or {}))
+
+
+def register_backend(backend: BackendStorage) -> BackendStorage:
+    with _registry_lock:
+        _backends[backend.name] = backend
+    return backend
+
+
+def get_backend(name: str) -> BackendStorage:
+    with _registry_lock:
+        b = _backends.get(name)
+    if b is None:
+        raise BackendError(f"storage backend {name!r} is not configured")
+    return b
+
+
+def clear_backends() -> None:
+    """Test hook."""
+    with _registry_lock:
+        _backends.clear()
+
+
+def _memory_factory(name: str, props: dict) -> BackendStorage:
+    return MemoryBackendStorage(name)
+
+
+register_backend_factory("memory", _memory_factory)
+
+# the "s3" scheme registers itself on import (kept in its own module so
+# this one stays dependency-light)
+from seaweedfs_tpu.storage import backend_s3  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# Tier metadata file (<base>.tier): which backend holds the .dat
+# (the reference records this in the .vif volume-info protobuf)
+# ---------------------------------------------------------------------------
+
+
+def tier_info_path(base_name: str) -> str:
+    return base_name + ".tier"
+
+
+def write_tier_info(base_name: str, backend_name: str, key: str,
+                    size: int) -> None:
+    info = {"backend": backend_name, "key": key, "size": size}
+    tmp = tier_info_path(base_name) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, tier_info_path(base_name))
+
+
+def read_tier_info(base_name: str) -> Optional[dict]:
+    p = tier_info_path(base_name)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def remove_tier_info(base_name: str) -> None:
+    p = tier_info_path(base_name)
+    if os.path.exists(p):
+        os.remove(p)

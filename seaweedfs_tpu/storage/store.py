@@ -9,10 +9,11 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from seaweedfs_tpu.storage.backend import read_tier_info
 from seaweedfs_tpu.storage.disk_location import DiskLocation
 from seaweedfs_tpu.storage.needle import Needle, NeedleError
 from seaweedfs_tpu.storage.superblock import ReplicaPlacement, TTL
-from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
 
 
 class Store:
@@ -95,6 +96,11 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             return False
+        if v.is_remote or read_tier_info(v.file_name()) is not None:
+            # a cloud-tiered volume stays sealed: local writes would
+            # silently diverge from the remote .dat
+            raise VolumeError(
+                f"volume {vid} is cloud-tiered; download it first")
         v.read_only = False
         return True
 

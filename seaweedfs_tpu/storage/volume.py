@@ -27,6 +27,10 @@ from typing import Optional
 
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.storage.backend import (
+    BackendError, BackendStorageFile, DiskFile, RemoteFile, get_backend,
+    read_tier_info,
+)
 from seaweedfs_tpu.storage.needle import (
     Needle, NeedleError, CookieMismatch, actual_size, VERSION3,
 )
@@ -167,7 +171,8 @@ class Volume:
         base = self.file_name()
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
-        existing = os.path.exists(self.dat_path)
+        existing = os.path.exists(self.dat_path) or \
+            read_tier_info(base) is not None
         if not existing and not create_if_missing:
             raise VolumeError(f"volume file {self.dat_path} missing")
         if existing:
@@ -179,9 +184,9 @@ class Volume:
         else:
             self.super_block = SuperBlock(
                 version=VERSION3, replica_placement=replica_placement, ttl=ttl)
-            self._dat = open(self.dat_path, "w+b")
-            self._dat.write(self.super_block.to_bytes())
-            self._dat.flush()
+            self._dat: BackendStorageFile = DiskFile(self.dat_path,
+                                                     create=True)
+            self._dat.write_at(self.super_block.to_bytes(), 0)
             self.nm = NeedleMap(self.idx_path)
 
     # -- naming --------------------------------------------------------------
@@ -203,14 +208,29 @@ class Volume:
     def _load(self) -> None:
         from seaweedfs_tpu.storage.vacuum import recover_compaction
         recover_compaction(self.file_name())
-        self._dat = open(self.dat_path, "r+b")
-        header = self._dat.read(8)
+        tier = read_tier_info(self.file_name())
+        if tier is not None and not os.path.exists(self.dat_path):
+            # cloud-tiered: the .dat lives in an object store; reads go
+            # through ranged GETs, the volume is sealed read-only
+            # (reference volume_tier.go LoadRemoteFile)
+            self._dat = RemoteFile(get_backend(tier["backend"]),
+                                   tier["key"], tier["size"])
+            self.read_only = True
+        else:
+            self._dat = DiskFile(self.dat_path)
+            if tier is not None:
+                # tiered with keep_local: serve reads from the faster
+                # local copy but stay sealed — new writes would silently
+                # diverge from the remote object the .tier file points at
+                self.read_only = True
+        header = self._dat.read_at(8, 0)
         if len(header) < 8:
             raise VolumeError(f"{self.dat_path}: truncated superblock")
         self.super_block = SuperBlock.from_bytes(header)
         self.version = self.super_block.version
         self.nm = NeedleMap(self.idx_path)
-        self._check_and_fix_integrity()
+        if not self._dat.is_remote:
+            self._check_and_fix_integrity()
 
     def _check_and_fix_integrity(self) -> None:
         """Truncate a torn tail: the .dat must end exactly after the last
@@ -220,7 +240,7 @@ class Volume:
         like the reference, do NOT truncate in that case (the .idx may
         simply be lost; `weed fix` / Volume.rebuild_index recovers it).
         """
-        dat_size = os.path.getsize(self.dat_path)
+        dat_size = self._dat.size()
         idx_size = os.path.getsize(self.idx_path) \
             if os.path.exists(self.idx_path) else 0
         if idx_size == 0:
@@ -309,8 +329,7 @@ class Volume:
         """Commit a batch of write/delete requests with one physical
         append. See _GroupCommitWriter for the protocol."""
         with self._lock:
-            self._dat.seek(0, os.SEEK_END)
-            batch_start = self._dat.tell()
+            batch_start = self._dat.size()
             buf = bytearray()
             staged = []  # (req, publish_fn, result)
             pending: dict[int, Optional[tuple[int, int]]] = {}
@@ -334,12 +353,10 @@ class Volume:
                     req.complete(error=e)
             if buf:
                 try:
-                    self._dat.seek(0, os.SEEK_END)
-                    self._dat.write(buf)
-                    self._dat.flush()
+                    self._dat.write_at(buf, batch_start)
                     if any_fsync:
-                        os.fsync(self._dat.fileno())
-                except OSError as e:
+                        self._dat.sync()
+                except (OSError, BackendError) as e:
                     # truncate-on-error: roll the .dat back to the batch
                     # start so no index entry ever points at torn bytes
                     # (reference volume_read_write.go:385-399)
@@ -455,8 +472,7 @@ class Volume:
     def _read_needle_at(self, offset: int, size: int,
                         check_crc: bool = True) -> Needle:
         length = actual_size(size, self.version)
-        self._dat.seek(offset)
-        blob = self._dat.read(length)
+        blob = self._dat.read_at(length, offset)
         if len(blob) < length:
             raise NeedleError(
                 f"short read at {offset}: {len(blob)} < {length}")
@@ -471,6 +487,10 @@ class Volume:
         encode, export) never races reads/writes on the shared handle.
         """
         import struct
+        if self._dat.is_remote:
+            raise VolumeError(
+                f"volume {self.id} is cloud-tiered; download it first "
+                "(VolumeTierMoveDatFromRemote) before scanning")
         size = os.path.getsize(self.dat_path)
         offset = 8
         with open(self.dat_path, "rb") as f:
@@ -501,7 +521,11 @@ class Volume:
 
     @property
     def content_size(self) -> int:
-        return os.path.getsize(self.dat_path)
+        return self._dat.size()
+
+    @property
+    def is_remote(self) -> bool:
+        return self._dat.is_remote
 
     @property
     def file_count(self) -> int:
@@ -523,8 +547,7 @@ class Volume:
         return self.content_size >= volume_size_limit
 
     def sync(self) -> None:
-        self._dat.flush()
-        os.fsync(self._dat.fileno())
+        self._dat.sync()
         self.nm.sync()
 
     def close(self) -> None:
@@ -533,12 +556,13 @@ class Volume:
         if writer is not None:
             writer.stop()
         with self._lock:
-            self._dat.flush()
             self._dat.close()
             self.nm.close()
 
     def destroy(self) -> None:
+        from seaweedfs_tpu.storage.backend import tier_info_path
         self.close()
-        for p in (self.dat_path, self.idx_path):
+        for p in (self.dat_path, self.idx_path,
+                  tier_info_path(self.file_name())):
             if os.path.exists(p):
                 os.remove(p)

@@ -1,0 +1,93 @@
+"""Cloud tier: move a sealed volume's .dat to an object store and back.
+
+Reference parity: weed/storage/volume_tier.go +
+weed/server/volume_grpc_tier_upload.go / _download.go.  The .idx (and
+the needle map built from it) always stays local — only the bulk .dat
+bytes move; reads on a tiered volume become ranged GETs through
+storage/backend.RemoteFile.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from seaweedfs_tpu.storage import backend as bk
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+from seaweedfs_tpu.util import wlog
+
+_log = wlog.logger("storage.tier")
+
+
+def _tier_key(v: Volume) -> str:
+    name = f"{v.collection}_{v.id}" if v.collection else str(v.id)
+    return f"volumes/{name}.dat"
+
+
+def move_dat_to_remote(v: Volume, backend_name: str,
+                       keep_local: bool = False,
+                       progress: Optional[Callable[[int], None]] = None
+                       ) -> int:
+    """Upload the .dat, record the .tier info, swap reads over to the
+    remote backend, optionally drop the local copy
+    (volume_grpc_tier_upload.go:24-99). The volume must be sealed
+    (read-only) first, like the reference requires."""
+    if v.is_remote:
+        raise VolumeError(f"volume {v.id} is already tiered")
+    if not v.read_only:
+        raise VolumeError(
+            f"volume {v.id} must be read-only before tiering (mark it "
+            "readonly / ec-seal it first)")
+    storage = bk.get_backend(backend_name)
+    key = _tier_key(v)
+    # the volume is sealed (read-only) so the .dat is immutable: the
+    # potentially minutes-long upload runs WITHOUT the volume lock —
+    # reads keep flowing; only the handle swap below needs it
+    v.sync()
+    size = v.content_size
+    total = storage.copy_file(v.dat_path, key, progress=progress)
+    if total != size:
+        storage.delete_file(key)
+        raise VolumeError(
+            f"volume {v.id}: uploaded {total} bytes != local {size}")
+    with v._lock:
+        bk.write_tier_info(v.file_name(), backend_name, key, size)
+        old = v._dat
+        v._dat = bk.RemoteFile(storage, key, size)
+        old.close()
+        if not keep_local:
+            os.remove(v.dat_path)
+    _log.info("volume %d tiered to %s (%d bytes, keep_local=%s)",
+              v.id, backend_name, size, keep_local)
+    return size
+
+
+def move_dat_from_remote(v: Volume, keep_remote: bool = False,
+                         progress: Optional[Callable[[int], None]] = None
+                         ) -> int:
+    """Download the .dat back next to its .idx and resume local reads
+    (volume_grpc_tier_download.go:23-91)."""
+    info = bk.read_tier_info(v.file_name())
+    if info is None or not v.is_remote:
+        raise VolumeError(f"volume {v.id} is not cloud-tiered")
+    storage = bk.get_backend(info["backend"])
+    # download to a shadow file without the volume lock (reads keep
+    # being served from the remote object meanwhile), swap under it
+    tmp = v.dat_path + ".tiertmp"
+    total = storage.download_file(info["key"], tmp, progress=progress)
+    if total != info["size"]:
+        os.remove(tmp)
+        raise VolumeError(
+            f"volume {v.id}: downloaded {total} bytes != "
+            f"recorded {info['size']}")
+    with v._lock:
+        os.replace(tmp, v.dat_path)
+        bk.remove_tier_info(v.file_name())
+        old = v._dat
+        v._dat = bk.DiskFile(v.dat_path)
+        old.close()
+    if not keep_remote:
+        storage.delete_file(info["key"])
+    _log.info("volume %d un-tiered from %s (%d bytes)",
+              v.id, info["backend"], total)
+    return total

@@ -1,0 +1,290 @@
+"""Backend SPI, cloud tier, incremental backup, and volume tail tests.
+
+Covers VERDICT round-1 item 7: BackendStorageFile/BackendStorage
+(reference backend/backend.go:15-74), VolumeTierMoveDatToRemote/
+FromRemote (volume_tier.go), and VolumeSyncStatus + VolumeIncrementalCopy
++ VolumeTailSender/Receiver (volume_backup.go:65-218,
+volume_grpc_tail.go).
+"""
+
+import os
+import time
+
+import pytest
+
+from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+from seaweedfs_tpu.storage import backend as bk
+from seaweedfs_tpu.storage import volume_backup, volume_tier
+from seaweedfs_tpu.storage.needle import Needle, NeedleError
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+
+from tests.cluster_util import Cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_backends():
+    bk.clear_backends()
+    yield
+    bk.clear_backends()
+
+
+# -- BackendStorageFile -------------------------------------------------------
+
+
+def test_disk_file_positional_io(tmp_path):
+    p = str(tmp_path / "f.bin")
+    f = bk.DiskFile(p, create=True)
+    f.write_at(b"hello world", 0)
+    f.write_at(b"WO", 6)
+    assert f.read_at(11, 0) == b"hello WOrld"
+    assert f.size() == 11
+    f.truncate(5)
+    assert f.size() == 5
+    assert f.read_at(100, 0) == b"hello"
+    f.close()
+
+
+def test_memory_backend_roundtrip(tmp_path):
+    be = bk.register_backend(bk.MemoryBackendStorage("memory.test"))
+    src = tmp_path / "a.dat"
+    src.write_bytes(b"x" * 1000)
+    assert be.copy_file(str(src), "k1") == 1000
+    assert be.read_range("k1", 10, 5) == b"xxxxx"
+    dst = tmp_path / "b.dat"
+    be.download_file("k1", str(dst))
+    assert dst.read_bytes() == b"x" * 1000
+    be.delete_file("k1")
+    with pytest.raises(bk.BackendError):
+        be.read_range("k1", 0, 1)
+
+
+def test_backend_configuration_registry():
+    bk.load_configuration({"memory.alpha": {}})
+    assert isinstance(bk.get_backend("memory.alpha"),
+                      bk.MemoryBackendStorage)
+    with pytest.raises(bk.BackendError):
+        bk.get_backend("s3.missing")
+    with pytest.raises(bk.BackendError):
+        bk.load_configuration({"bogus.x": {}})
+
+
+# -- cloud tier ---------------------------------------------------------------
+
+
+def _fill_volume(tmp_path, vid=1, n=20):
+    v = Volume(str(tmp_path), "", vid)
+    for i in range(1, n + 1):
+        v.write_needle(Needle(id=i, cookie=0x10 + i, data=b"payload-%d" % i))
+    return v
+
+
+def test_tier_roundtrip_local_reads_remote(tmp_path):
+    bk.register_backend(bk.MemoryBackendStorage("memory.tier"))
+    v = _fill_volume(tmp_path)
+    with pytest.raises(VolumeError):
+        volume_tier.move_dat_to_remote(v, "memory.tier")  # not readonly yet
+    v.read_only = True
+    size = volume_tier.move_dat_to_remote(v, "memory.tier")
+    assert size == v.content_size
+    assert not os.path.exists(v.dat_path)       # local .dat gone
+    assert v.is_remote
+    # reads go through ranged GETs on the object store
+    got = v.read_needle(Needle(id=7, cookie=0x17))
+    assert got.data == b"payload-7"
+    # writes are rejected while tiered
+    with pytest.raises(VolumeError):
+        v.write_needle(Needle(id=99, cookie=1, data=b"no"))
+    # reload from disk: the .tier file is enough to reopen the volume
+    v.close()
+    v2 = Volume(str(tmp_path), "", 1, create_if_missing=False)
+    assert v2.is_remote and v2.read_only
+    assert v2.read_needle(Needle(id=20, cookie=0x24)).data == b"payload-20"
+    # download back
+    volume_tier.move_dat_from_remote(v2)
+    assert not v2.is_remote
+    assert os.path.exists(v2.dat_path)
+    assert v2.read_needle(Needle(id=3, cookie=0x13)).data == b"payload-3"
+    assert bk.read_tier_info(v2.file_name()) is None
+    v2.close()
+
+
+# -- sync status / binary search / incremental backup -------------------------
+
+
+def test_sync_status_and_last_append_ns(tmp_path):
+    v = _fill_volume(tmp_path, vid=2, n=5)
+    st = volume_backup.sync_status(v)
+    assert st["tail_offset"] == v.content_size
+    assert st["compact_revision"] == 0
+    assert st["idx_file_size"] == 5 * 16
+    assert volume_backup.last_append_at_ns(v) == v.last_append_at_ns
+    v.close()
+
+
+def test_binary_search_by_append_at_ns(tmp_path):
+    v = Volume(str(tmp_path), "", 3)
+    stamps = []
+    offsets = []
+    for i in range(1, 11):
+        off, _ = v.write_needle(Needle(id=i, cookie=i, data=b"d%d" % i))
+        offsets.append(off)
+        stamps.append(v.last_append_at_ns)
+        time.sleep(0.002)
+    # since 0 -> first record
+    off, is_last = volume_backup.binary_search_by_append_at_ns(v, 0)
+    assert (off, is_last) == (offsets[0], False)
+    # since stamp[4] -> record 6 (first strictly newer)
+    off, is_last = volume_backup.binary_search_by_append_at_ns(v, stamps[4])
+    assert (off, is_last) == (offsets[5], False)
+    # since the newest stamp -> nothing newer
+    _, is_last = volume_backup.binary_search_by_append_at_ns(v, stamps[-1])
+    assert is_last
+    v.close()
+
+
+def test_incremental_backup_applies_delta_and_deletes(tmp_path):
+    src_dir = tmp_path / "src"
+    dst_dir = tmp_path / "dst"
+    src_dir.mkdir()
+    dst_dir.mkdir()
+    src = _fill_volume(src_dir, vid=4, n=6)
+    dst = Volume(str(dst_dir), "", 4)
+
+    def ship():
+        since = volume_backup.last_append_at_ns(dst)
+        off, is_last = volume_backup.binary_search_by_append_at_ns(src, since)
+        chunks = [] if is_last else volume_backup.read_dat_range(src, off)
+        return volume_backup.apply_incremental(dst, chunks)
+
+    assert ship() > 0
+    assert dst.file_count == 6
+    assert dst.read_needle(Needle(id=5, cookie=0x15)).data == b"payload-5"
+    # delta: two more writes + one delete on the source
+    src.write_needle(Needle(id=7, cookie=0x17, data=b"payload-7"))
+    src.delete_needle(Needle(id=2, cookie=0x12))
+    assert ship() > 0
+    assert dst.read_needle(Needle(id=7, cookie=0x17)).data == b"payload-7"
+    with pytest.raises(NeedleError):
+        dst.read_needle(Needle(id=2, cookie=0x12))
+    # idempotent: nothing newer -> nothing shipped
+    assert ship() == 0
+    src.close()
+    dst.close()
+
+
+# -- through the RPC surface (cluster) ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("backup_tier"), n_volume_servers=2)
+    yield c
+    c.stop()
+
+
+def test_rpc_sync_status_and_incremental_copy(cluster):
+    fid = cluster.upload(b"rpc-backup-1")
+    vid = int(fid.split(",")[0])
+    url = cluster.wait_for(
+        lambda: cluster.master.topo.lookup(vid), what="vid location")[0].url
+    stub = volume_stub(url)
+    st = stub.VolumeSyncStatus(
+        volume_server_pb2.VolumeSyncStatusRequest(volume_id=vid))
+    assert st.tail_offset > 8
+    chunks = list(stub.VolumeIncrementalCopy(
+        volume_server_pb2.VolumeIncrementalCopyRequest(
+            volume_id=vid, since_ns=0)))
+    got = b"".join(c.file_content for c in chunks)
+    assert b"rpc-backup-1" in got
+
+
+def test_rpc_tail_receiver_follows_source(cluster, tmp_path):
+    fid = cluster.upload(b"tail-me-1")
+    vid = int(fid.split(",")[0])
+    urls = [n.url for n in cluster.wait_for(
+        lambda: cluster.master.topo.lookup(vid), what="vid location")]
+    src_url = urls[0]
+    # a second volume server that does NOT hold this volume acts as the
+    # receiver: pre-create the empty replica there, then pull the tail
+    recv_vs = next(vs for vs in cluster.volume_servers
+                   if vs.url not in urls)
+    recv_vs.store.add_volume(vid)
+    stub = volume_stub(recv_vs.url)
+    stub.VolumeTailReceiver(
+        volume_server_pb2.VolumeTailReceiverRequest(
+            volume_id=vid, since_ns=0, idle_timeout_seconds=2,
+            source_volume_server=src_url))
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    f = parse_fid(fid)
+    n = recv_vs.store.read_needle(vid, Needle(id=f.key, cookie=f.cookie))
+    assert n.data == b"tail-me-1"
+
+
+def test_rpc_tier_upload_download(cluster):
+    # registered after the autouse clear so the same instance serves
+    # both the upload and the download half of the roundtrip
+    bk.register_backend(bk.MemoryBackendStorage("memory.cluster"))
+    fid = cluster.upload(b"tier-rpc-payload")
+    vid = int(fid.split(",")[0])
+    url = cluster.wait_for(
+        lambda: cluster.master.topo.lookup(vid), what="vid location")[0].url
+    stub = volume_stub(url)
+    stub.VolumeMarkReadonly(
+        volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+    resp = list(stub.VolumeTierMoveDatToRemote(
+        volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
+            volume_id=vid, destination_backend_name="memory.cluster")))
+    assert resp and resp[-1].processed > 0
+    # reads still work (served from the object store through RemoteFile)
+    with cluster.fetch(fid) as r:
+        assert r.read() == b"tier-rpc-payload"
+    # bring it back
+    resp = list(stub.VolumeTierMoveDatFromRemote(
+        volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
+            volume_id=vid)))
+    assert resp and resp[-1].processed > 0
+    with cluster.fetch(fid) as r:
+        assert r.read() == b"tier-rpc-payload"
+
+
+# -- S3 tier backend against our own S3 gateway -------------------------------
+
+
+def test_s3_backend_tier_roundtrip(tmp_path):
+    """The s3.* tier backend speaks real SigV4 against the in-repo S3
+    gateway: upload the .dat, serve needle reads via ranged GETs,
+    download it back (reference backend/s3_backend/s3_backend.go)."""
+    from seaweedfs_tpu.s3api import Credential, Iam, Identity, S3ApiServer
+    from seaweedfs_tpu.s3api.auth import ACTION_ADMIN
+    from tests.cluster_util import free_port_pair
+
+    access, secret = "TIERKEY", "TIERSECRET"
+    c = Cluster(tmp_path / "cluster", n_volume_servers=1, with_filer=True)
+    s3srv = S3ApiServer(
+        filer_url=c.filer.url, port=free_port_pair(),
+        iam=Iam([Identity(name="admin",
+                          credentials=[Credential(access, secret)],
+                          actions=[ACTION_ADMIN])]))
+    s3srv.start()
+    try:
+        from seaweedfs_tpu.util.s3_client import S3Client
+        S3Client(s3srv.url, access, secret).create_bucket("tierbkt")
+        bk.load_configuration({"s3.gw": {
+            "endpoint": s3srv.url, "bucket": "tierbkt",
+            "access_key": access, "secret_key": secret}})
+        vol_dir = tmp_path / "vols"
+        vol_dir.mkdir()
+        v = _fill_volume(vol_dir, vid=9, n=10)
+        v.read_only = True
+        size = volume_tier.move_dat_to_remote(v, "s3.gw")
+        assert size == v.content_size
+        assert not os.path.exists(v.dat_path)
+        # ranged reads through the gateway
+        assert v.read_needle(Needle(id=4, cookie=0x14)).data == b"payload-4"
+        volume_tier.move_dat_from_remote(v)
+        assert os.path.exists(v.dat_path)
+        assert v.read_needle(Needle(id=9, cookie=0x19)).data == b"payload-9"
+        v.close()
+    finally:
+        s3srv.stop()
+        c.stop()

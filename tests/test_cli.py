@@ -198,3 +198,40 @@ def test_export_tar(tmp_path):
         assert "file1" in names and "file5" not in names
         got = tar.extractfile("file3").read()
         assert got == fids[3]
+
+
+def test_backup_incremental(cli_cluster, tmp_path):
+    """`backup` keeps a local volume replica in sync (incremental on
+    the second run; reference weed/command/backup.go)."""
+    master = cli_cluster["master"]
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"backup-payload-1")
+    up = run_cli("upload", "-master", master, str(src))
+    assert up.returncode == 0, up.stderr
+    import json as _json
+    fid = _json.loads(up.stdout)[0]["fid"]
+    vid = fid.split(",")[0]
+    bdir = tmp_path / "bak"
+    bdir.mkdir()
+    r1 = run_cli("backup", "-server", master, "-volumeId", vid,
+                 "-dir", str(bdir))
+    assert r1.returncode == 0, r1.stderr
+    assert f"{vid}.dat" in os.listdir(bdir)
+    size1 = os.path.getsize(bdir / f"{vid}.dat")
+    # second run: nothing new -> +0 bytes
+    r2 = run_cli("backup", "-server", master, "-volumeId", vid,
+                 "-dir", str(bdir))
+    assert r2.returncode == 0, r2.stderr
+    assert "+0 bytes" in r2.stdout
+    # write more, then an incremental catch-up grows the replica
+    src.write_bytes(b"backup-payload-2-bigger")
+    up2 = run_cli("upload", "-master", master, str(src))
+    assert up2.returncode == 0, up2.stderr
+    r3 = run_cli("backup", "-server", master, "-volumeId", vid,
+                 "-dir", str(bdir))
+    assert r3.returncode == 0, r3.stderr
+    fid2 = _json.loads(up2.stdout)[0]["fid"]
+    if fid2.split(",")[0] == vid:
+        # only asserts growth when the second upload landed on the same
+        # volume (assignment is free to pick another one)
+        assert os.path.getsize(bdir / f"{vid}.dat") > size1
