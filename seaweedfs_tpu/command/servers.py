@@ -56,6 +56,9 @@ def _master_parser() -> argparse.ArgumentParser:
                    type=float, default=0.3)
     p.add_argument("-pulseSeconds", dest="pulse_seconds", type=float,
                    default=5.0)
+    p.add_argument("-peers", default="",
+                   help="comma-separated ip:port of ALL masters "
+                        "(including this one) for raft HA")
     p.add_argument("-cpuprofile", default=None)
     return p
 
@@ -64,12 +67,19 @@ def _build_master(opts):
     from seaweedfs_tpu.server.master import MasterServer
     if opts.mdir:
         os.makedirs(opts.mdir, exist_ok=True)
+    peers = [x.strip() for x in (opts.peers or "").split(",") if x.strip()]
+    if peers and len(peers) % 2 == 0:
+        # the reference enforces an odd master count so elections can't
+        # tie (command/master.go:167-196)
+        log.warning("master count %d is even; raft needs an odd number "
+                    "of peers to avoid split votes", len(peers))
     return MasterServer(
         ip=opts.ip, port=opts.port, meta_dir=opts.mdir,
         volume_size_limit_mb=opts.volume_size_limit_mb,
         default_replication=opts.default_replication,
         pulse_seconds=opts.pulse_seconds,
         garbage_threshold=opts.garbage_threshold,
+        peers=peers,
     )
 
 

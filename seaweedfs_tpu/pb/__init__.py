@@ -7,11 +7,11 @@ fresh for this framework. Regenerate with pb/gen.sh.
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.pb import (filer_pb2, master_pb2, messaging_pb2,
-                              volume_server_pb2)
+                              raft_pb2, volume_server_pb2)
 
 __all__ = ["master_pb2", "volume_server_pb2", "filer_pb2",
-           "messaging_pb2", "master_stub", "volume_stub", "filer_stub",
-           "messaging_stub"]
+           "messaging_pb2", "raft_pb2", "master_stub", "volume_stub",
+           "filer_stub", "messaging_stub", "raft_stub"]
 
 
 def master_stub(url_or_target: str, is_http_url: bool = True):
@@ -32,3 +32,9 @@ def filer_stub(url_or_target: str, is_http_url: bool = True):
 def messaging_stub(url_or_target: str, is_http_url: bool = True):
     target = rpc.grpc_address(url_or_target) if is_http_url else url_or_target
     return rpc.make_stub(messaging_pb2, "SeaweedMessaging", target)
+
+
+def raft_stub(url_or_target: str, is_http_url: bool = True):
+    """Raft rides the master's gRPC server (reference command/master.go:144)."""
+    target = rpc.grpc_address(url_or_target) if is_http_url else url_or_target
+    return rpc.make_stub(raft_pb2, "Raft", target)

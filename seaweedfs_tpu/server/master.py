@@ -24,8 +24,10 @@ from urllib.parse import parse_qs, urlparse
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.util import wlog
-from seaweedfs_tpu.pb import master_pb2, volume_server_pb2, volume_stub
+from seaweedfs_tpu.pb import master_pb2, raft_pb2, volume_server_pb2, \
+    volume_stub
 from seaweedfs_tpu.server import convert
+from seaweedfs_tpu.server.raft import NotLeader, RaftNode
 from seaweedfs_tpu.storage.superblock import ReplicaPlacement
 from seaweedfs_tpu.topology.sequence import MemorySequencer
 from seaweedfs_tpu.topology.topology import Topology
@@ -64,12 +66,16 @@ class AdminLock:
 
 
 class MasterServer:
+    SEQ_WATERMARK_GAP = 10000  # ids raft-committed ahead of allocation
+
     def __init__(self, ip: str = "127.0.0.1", port: int = 9333,
                  meta_dir: Optional[str] = None,
                  volume_size_limit_mb: int = 30 * 1024,
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 peers: Optional[List[str]] = None,
+                 raft_election_timeout: float = 0.5):
         self.ip = ip
         self.port = port
         self.meta_dir = meta_dir
@@ -80,6 +86,19 @@ class MasterServer:
                              sequencer=seq, pulse_seconds=pulse_seconds)
         self.growth = VolumeGrowth(self.topo)
         self.admin_lock = AdminLock()
+        # raft: single-node (no peers) degenerates to permanent leader.
+        # NB: RaftNode.__init__ replays the committed log through
+        # _raft_apply before self.raft exists — the apply/restore
+        # callbacks must not touch self.raft (they use _applied_state).
+        self._applied_state = {"max_volume_id": 0, "sequence": 0}
+        self._seq_watermark = 0
+        self._seq_lock = threading.Lock()
+        self.raft = RaftNode(
+            f"{ip}:{port}", peers or [], meta_dir,
+            apply=self._raft_apply,
+            snapshot_fn=lambda: dict(self._applied_state),
+            restore_fn=self._raft_restore,
+            election_timeout=raft_election_timeout)
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -102,8 +121,11 @@ class MasterServer:
         if self.port == 0:
             raise ValueError("master port must be fixed (grpc = port+10000)")
         handler = rpc.generic_handler(master_pb2, "Seaweed", self)
+        raft_handler = rpc.generic_handler(raft_pb2, "Raft", self.raft)
         self._grpc_server = rpc.make_server(
-            f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
+            f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}",
+            [handler, raft_handler])
+        self.raft.start()
         self._http_server = ThreadingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
         self._http_thread = threading.Thread(
@@ -116,6 +138,7 @@ class MasterServer:
     def stop(self) -> None:
         log.info("master %s stopping", self.url)
         self._stopping = True
+        self.raft.stop()
         self._save_sequence()
         if self._http_server:
             self._http_server.shutdown()
@@ -143,6 +166,74 @@ class MasterServer:
                 json.dump({"next": self.topo.sequence.peek}, f)
             os.replace(tmp, p)
 
+    # -- raft ------------------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader
+
+    def leader_url(self) -> Optional[str]:
+        return self.raft.leader()
+
+    def _require_leader(self) -> None:
+        if not self.raft.is_leader:
+            raise NotLeader(self.raft.leader())
+
+    def _raft_apply(self, cmd: dict, term: int = 0) -> None:
+        """Committed-log state machine: max volume id + file-id
+        sequence watermarks (the state the reference snapshots via
+        chrislusf/raft; server/raft_server.go:21-60).
+
+        Runs during RaftNode.__init__ replay (before self.raft is
+        assigned), so it must not dereference self.raft."""
+        op = cmd.get("op")
+        raft = getattr(self, "raft", None)
+        if op == "max_volume_id":
+            value = int(cmd["value"])
+            self.topo.adjust_max_volume_id(value)
+            self._applied_state["max_volume_id"] = max(
+                self._applied_state["max_volume_id"], value)
+        elif op == "sequence":
+            value = int(cmd["value"])
+            self._applied_state["sequence"] = max(
+                self._applied_state["sequence"], value)
+            # Raise the sequencer floor for every watermark EXCEPT the
+            # sitting leader's own current-term proposals (its
+            # in-memory sequence is the source of truth there). A
+            # prior-term watermark applied after winning an election
+            # must still raise the floor, or this leader re-issues file
+            # ids the dead leader already handed out.
+            own_proposal = raft is not None and raft.is_leader and                 term == raft.current_term
+            if not own_proposal:
+                self.topo.sequence.set_max(value)
+
+    def _raft_restore(self, state: dict) -> None:
+        """Reinstall a raft snapshot (log compaction / catch-up)."""
+        if not state:
+            return
+        self._applied_state.update({
+            "max_volume_id": int(state.get("max_volume_id", 0)),
+            "sequence": int(state.get("sequence", 0))})
+        if self._applied_state["max_volume_id"]:
+            self.topo.adjust_max_volume_id(
+                self._applied_state["max_volume_id"])
+        if self._applied_state["sequence"]:
+            self.topo.sequence.set_max(self._applied_state["sequence"])
+
+    def _ensure_sequence_watermark(self, count: int) -> None:
+        """Guarantee the raft-committed watermark stays ahead of every
+        id this assign can allocate. Caller holds _seq_lock, so the
+        check-then-allocate window is atomic: no id >= the committed
+        watermark is ever handed out, and a failed-over leader resuming
+        at the watermark can never duplicate one."""
+        if not self.raft.peers:
+            return
+        peek = self.topo.sequence.peek
+        if peek + count >= self._seq_watermark:
+            new_wm = peek + count + self.SEQ_WATERMARK_GAP
+            self.raft.propose({"op": "sequence", "value": new_wm})
+            self._seq_watermark = new_wm
+
     # -- KeepConnected fan-out -----------------------------------------------
 
     def _broadcast(self, loc: master_pb2.VolumeLocation) -> None:
@@ -163,6 +254,13 @@ class MasterServer:
     # -- gRPC: Seaweed service ------------------------------------------------
 
     def SendHeartbeat(self, request_iterator, context):
+        if not self.raft.is_leader:
+            # tell the volume server who the leader is and end the
+            # stream; it redials (reference master_grpc_server.go:20-28)
+            next(request_iterator, None)
+            yield master_pb2.HeartbeatResponse(
+                leader=self.raft.leader() or "")
+            return
         node_url = None
         stream_id = object()  # identity of THIS connection
         try:
@@ -186,6 +284,10 @@ class MasterServer:
                     self._broadcast(master_pb2.VolumeLocation(
                         url=node.url, public_url=node.public_url,
                         new_vids=new, deleted_vids=deleted))
+                if not self.raft.is_leader:
+                    yield master_pb2.HeartbeatResponse(
+                        leader=self.raft.leader() or "")
+                    return
                 yield master_pb2.HeartbeatResponse(
                     volume_size_limit=self.topo.volume_size_limit,
                     leader=self.url)
@@ -212,6 +314,10 @@ class MasterServer:
         try:
             next(request_iterator)  # client introduces itself
         except StopIteration:
+            return
+        if not self.raft.is_leader:
+            yield master_pb2.VolumeLocation(
+                leader=self.raft.leader() or "")
             return
         q: queue.Queue = queue.Queue()
         with self._sub_lock:
@@ -273,7 +379,7 @@ class MasterServer:
                 ttl=request.ttl,
                 data_center=request.data_center,
                 writable_volume_count=request.writable_volume_count)
-        except (NoFreeSlots, RuntimeError) as e:
+        except (NoFreeSlots, RuntimeError, NotLeader, TimeoutError) as e:
             return master_pb2.AssignResponse(error=str(e))
         fid, count, locs = result
         return master_pb2.AssignResponse(
@@ -283,6 +389,7 @@ class MasterServer:
     def assign(self, count: int = 1, replication: str = "",
                collection: str = "", ttl: str = "", data_center: str = "",
                writable_volume_count: int = 0):
+        self._require_leader()
         rp = ReplicaPlacement.parse(replication or self.default_replication)
         rb = rp.to_byte()
         if not self.topo.has_writable(collection, rb, ttl):
@@ -292,8 +399,11 @@ class MasterServer:
                         writable_volume_count or growth_count(rp.copy_count),
                         replication or self.default_replication,
                         collection, ttl, data_center)
-        picked = self.topo.pick_for_write(
-            count=count, collection=collection, replica_byte=rb, ttl=ttl)
+        with self._seq_lock:
+            self._ensure_sequence_watermark(count)
+            picked = self.topo.pick_for_write(
+                count=count, collection=collection, replica_byte=rb,
+                ttl=ttl)
         if picked is None:
             raise RuntimeError("no writable volumes")
         return picked
@@ -303,6 +413,7 @@ class MasterServer:
                      data_center: str = "") -> List[int]:
         """AutomaticGrowByType: allocate `target_count` new volumes on
         placement-picked servers (reference volume_growth.go:70-240)."""
+        self._require_leader()
         rp = ReplicaPlacement.parse(replication or self.default_replication)
         grown = []
         for _ in range(max(1, target_count)):
@@ -313,6 +424,10 @@ class MasterServer:
                     break  # partial growth still unblocks the assign
                 raise
             vid = self.topo.reserve_volume_ids(1)[0]
+            # replicate the new max volume id before using it, so a
+            # failed-over leader never re-issues vids (reference
+            # topology.go NextVolumeId raft command)
+            self.raft.propose({"op": "max_volume_id", "value": vid})
             ok_nodes = []
             for n in nodes:
                 try:
@@ -408,7 +523,10 @@ class MasterServer:
                 for sid, urls in sorted(shard_locs.items())])
 
     def VacuumVolume(self, request, context):
-        self.vacuum(request.garbage_threshold or self.garbage_threshold)
+        try:
+            self.vacuum(request.garbage_threshold or self.garbage_threshold)
+        except NotLeader as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return master_pb2.VacuumVolumeResponse()
 
     def GetMasterConfiguration(self, request, context):
@@ -430,6 +548,7 @@ class MasterServer:
     def vacuum(self, garbage_threshold: Optional[float] = None) -> List[int]:
         """Poll garbage ratios and compact over-threshold volumes on all
         replicas (reference topology/topology_vacuum.go:17-201)."""
+        self._require_leader()
         threshold = garbage_threshold or self.garbage_threshold
         compacted = []
         seen: Set[int] = set()
@@ -477,7 +596,7 @@ class MasterServer:
                 collection=params.get("collection", [""])[0],
                 ttl=params.get("ttl", [""])[0],
                 data_center=params.get("dataCenter", [""])[0])
-        except (NoFreeSlots, RuntimeError) as e:
+        except (NoFreeSlots, RuntimeError, NotLeader, TimeoutError) as e:
             return {"error": str(e)}
         return {"fid": fid, "url": locs[0].url,
                 "publicUrl": locs[0].public_url, "count": count}
@@ -503,12 +622,14 @@ class MasterServer:
                 params.get("collection", [""])[0],
                 params.get("ttl", [""])[0],
                 params.get("dataCenter", [""])[0])
-        except NoFreeSlots as e:
+        except (NoFreeSlots, NotLeader, TimeoutError, RuntimeError) as e:
             return {"error": str(e)}
         return {"count": len(grown), "volumeIds": grown}
 
     def http_cluster_status(self) -> dict:
-        return {"IsLeader": True, "Leader": self.url, "Peers": []}
+        return {"IsLeader": self.raft.is_leader,
+                "Leader": self.raft.leader() or "",
+                "Peers": self.raft.peers}
 
 
 def _make_http_handler(ms: MasterServer):
@@ -526,9 +647,41 @@ def _make_http_handler(ms: MasterServer):
             self.end_headers()
             self.wfile.write(body)
 
+        def _proxy_to_leader(self) -> bool:
+            """Forward this request to the raft leader (reference
+            master_server.go:155-185 proxyToLeader). Returns True if
+            the request was handled (proxied or error-answered)."""
+            if ms.raft.is_leader:
+                return False
+            leader = ms.raft.leader()
+            if not leader:
+                self._json({"error": "no raft leader elected yet"},
+                           code=503)
+                return True
+            import urllib.request as _rq
+            import urllib.error as _er
+            url = f"http://{leader}{self.path}"
+            try:
+                with _rq.urlopen(_rq.Request(url, method=self.command),
+                                 timeout=30) as r:
+                    body = r.read()
+                    self.send_response(r.status)
+                    self.send_header(
+                        "Content-Type",
+                        r.headers.get("Content-Type", "application/json"))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+            except _er.URLError as e:
+                self._json({"error": f"leader {leader} unreachable: {e}"},
+                           code=502)
+            return True
+
         def do_GET(self):
             u = urlparse(self.path)
             params = parse_qs(u.query)
+            if u.path != "/cluster/status" and self._proxy_to_leader():
+                return
             if u.path == "/dir/assign":
                 self._json(ms.http_assign(params))
             elif u.path == "/dir/lookup":

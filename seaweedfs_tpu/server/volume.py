@@ -64,6 +64,10 @@ class VolumeServer:
             from seaweedfs_tpu.storage import backend as _bk
             _bk.load_configuration(storage_backends)
         self.master_url = master_url
+        # the master this server last heartbeated successfully (the
+        # leader); master_url may be a comma-separated candidate list,
+        # so lookups must dial this, never the raw flag value
+        self.current_master = master_url.split(",")[0].strip()
         self.ip = ip
         self.port = port
         self.data_center = data_center
@@ -132,16 +136,35 @@ class VolumeServer:
             self._hb_wake.clear()
 
     def _heartbeat_loop(self) -> None:
+        """Keep one bidi heartbeat stream to the master LEADER.
+
+        master_url may list several masters (comma-separated); a
+        follower answers with the leader's address and the loop redials
+        it (reference volume_grpc_client_to_master.go:50-95 follows
+        HeartbeatResponse.leader the same way).
+        """
+        candidates = [m.strip() for m in self.master_url.split(",")
+                      if m.strip()]
+        target = candidates[0]
+        rotate = 0
         while not self._stopping:
+            redirect = None
             try:
-                stub = master_stub(self.master_url)
+                stub = master_stub(target)
                 self._hb_call = stub.SendHeartbeat(self._heartbeat_gen())
                 connected = False
                 for resp in self._hb_call:
+                    if resp.leader and resp.leader != target:
+                        redirect = resp.leader
+                        log.info("master %s redirects heartbeat to "
+                                 "leader %s", target, redirect)
+                        self._hb_call.cancel()
+                        break
                     if not connected:
                         connected = True
+                        self.current_master = target
                         log.info("heartbeat stream to master %s established",
-                                 self.master_url)
+                                 target)
                     if resp.volume_size_limit:
                         self.volume_size_limit = resp.volume_size_limit
                     if self._stopping:
@@ -150,9 +173,21 @@ class VolumeServer:
                 if self._stopping:
                     return
                 log.warning("heartbeat stream to master %s broken (%s); "
-                            "reconnecting", self.master_url,
+                            "reconnecting", target,
                             getattr(e, "code", lambda: e)())
                 time.sleep(min(self.pulse_seconds, 1.0))
+            if self._stopping:
+                return
+            if redirect:
+                target = redirect
+            else:
+                # rotate through the configured masters on plain breaks
+                # — with a pause, so a leaderless election window
+                # doesn't turn into a tight redial spin
+                rotate += 1
+                target = candidates[rotate % len(candidates)]
+                self._hb_wake.wait(timeout=min(self.pulse_seconds, 1.0))
+                self._hb_wake.clear()
 
     def trigger_heartbeat(self) -> None:
         """Push a delta heartbeat now instead of waiting out the pulse."""
@@ -503,6 +538,7 @@ class VolumeServer:
             volume_tier.move_dat_to_remote(
                 v, request.destination_backend_name,
                 keep_local=request.keep_local_dat_file,
+                owner=self.url,
                 progress=progress)
         except (VolumeError, BackendError) as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
@@ -691,7 +727,7 @@ class VolumeServer:
             return cached[1]
         locs: Dict[int, List[str]] = {}
         try:
-            resp = master_stub(self.master_url).LookupEcVolume(
+            resp = master_stub(self.current_master).LookupEcVolume(
                 master_pb2.LookupEcVolumeRequest(volume_id=vid))
             for sl in resp.shard_id_locations:
                 locs[sl.shard_id] = [l.url for l in sl.locations]
@@ -709,7 +745,7 @@ class VolumeServer:
 
     def _other_replicas(self, vid: int) -> List[str]:
         try:
-            resp = master_stub(self.master_url).LookupVolume(
+            resp = master_stub(self.current_master).LookupVolume(
                 master_pb2.LookupVolumeRequest(volume_ids=[str(vid)]))
         except grpc.RpcError:
             return []
@@ -873,7 +909,7 @@ def _make_http_handler(vs: VolumeServer):
 
         def _redirect_to_replica(self, f) -> None:
             try:
-                resp = master_stub(vs.master_url).LookupVolume(
+                resp = master_stub(vs.current_master).LookupVolume(
                     master_pb2.LookupVolumeRequest(
                         volume_ids=[str(f.volume_id)]))
             except grpc.RpcError:

@@ -19,13 +19,19 @@ from seaweedfs_tpu.util import wlog
 _log = wlog.logger("storage.tier")
 
 
-def _tier_key(v: Volume) -> str:
+def _tier_key(v: Volume, owner: str = "") -> str:
+    """Object key for a volume's .dat. `owner` (the uploading server's
+    url) keeps replicas of the same volume from clobbering each other's
+    objects — replica .dat files are NOT byte-identical (append
+    timestamps and write order differ per server)."""
     name = f"{v.collection}_{v.id}" if v.collection else str(v.id)
-    return f"volumes/{name}.dat"
+    prefix = f"volumes/{owner.replace(':', '_')}/" if owner else "volumes/"
+    return f"{prefix}{name}.dat"
 
 
 def move_dat_to_remote(v: Volume, backend_name: str,
                        keep_local: bool = False,
+                       owner: str = "",
                        progress: Optional[Callable[[int], None]] = None
                        ) -> int:
     """Upload the .dat, record the .tier info, swap reads over to the
@@ -39,7 +45,7 @@ def move_dat_to_remote(v: Volume, backend_name: str,
             f"volume {v.id} must be read-only before tiering (mark it "
             "readonly / ec-seal it first)")
     storage = bk.get_backend(backend_name)
-    key = _tier_key(v)
+    key = _tier_key(v, owner)
     # the volume is sealed (read-only) so the .dat is immutable: the
     # potentially minutes-long upload runs WITHOUT the volume lock —
     # reads keep flowing; only the handle swap below needs it

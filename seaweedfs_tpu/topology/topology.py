@@ -207,6 +207,13 @@ class Topology:
             self.next_volume_id += count
             return list(range(first, first + count))
 
+    def adjust_max_volume_id(self, vid: int) -> None:
+        """Raise the next-volume-id floor (raft MaxVolumeId command
+        replay; reference topology.go UpAdjustMaxVolumeId)."""
+        with self._lock:
+            if vid >= self.next_volume_id:
+                self.next_volume_id = vid + 1
+
     # -- deltas to subscribers ------------------------------------------------
 
     def _notify(self) -> None:

@@ -1,0 +1,204 @@
+"""Raft multi-master HA tests (VERDICT round-1 item 4).
+
+Reference behavior being matched: weed/server/raft_server.go:21-160
+(one elected leader among an odd master set), master_server.go:155-185
+(HTTP proxy-to-leader), volume_grpc_client_to_master.go:50-95 (volume
+servers follow HeartbeatResponse.leader), command/master.go:167-196
+(odd peer count).
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.raft import NotLeader
+from seaweedfs_tpu.server.volume import VolumeServer
+
+from tests.cluster_util import free_port_pair
+
+
+def _wait_for(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _start_masters(tmp_path, n=3, election_timeout=0.25):
+    ports = [free_port_pair() for _ in range(n)]
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        m = MasterServer(port=p, meta_dir=str(tmp_path / f"m{i}"),
+                         peers=urls, pulse_seconds=0.2,
+                         raft_election_timeout=election_timeout)
+        m.start()
+        masters.append(m)
+    return masters, urls
+
+
+def _leader_of(masters):
+    leaders = [m for m in masters if m.raft.is_leader]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_election_and_replicated_state(tmp_path):
+    masters, urls = _start_masters(tmp_path)
+    try:
+        leader = _wait_for(lambda: _leader_of(masters), what="a leader")
+        followers = [m for m in masters if m is not leader]
+        # every node agrees on who leads
+        _wait_for(lambda: all(m.raft.leader() == leader.url
+                              for m in masters), what="leader agreement")
+        # followers refuse control-plane writes
+        with pytest.raises(NotLeader):
+            followers[0].assign()
+        # a committed command reaches every follower's state machine
+        leader.raft.propose({"op": "max_volume_id", "value": 41})
+        _wait_for(lambda: all(m.topo.next_volume_id >= 42
+                              for m in masters),
+                  what="max volume id replication")
+    finally:
+        for m in masters:
+            m.stop()
+
+
+def test_leader_failover_new_leader_emerges(tmp_path):
+    masters, urls = _start_masters(tmp_path)
+    try:
+        leader = _wait_for(lambda: _leader_of(masters), what="a leader")
+        leader.raft.propose({"op": "max_volume_id", "value": 7})
+        survivors = [m for m in masters if m is not leader]
+        leader.stop()
+        new_leader = _wait_for(lambda: _leader_of(survivors),
+                               what="failover leader")
+        assert new_leader is not leader
+        # replicated state survived the failover
+        assert new_leader.topo.next_volume_id >= 8
+        # and the new leader can commit with the remaining quorum
+        new_leader.raft.propose({"op": "max_volume_id", "value": 99})
+        _wait_for(lambda: all(m.topo.next_volume_id >= 100
+                              for m in survivors),
+                  what="post-failover replication")
+    finally:
+        for m in masters:
+            m.stop()
+
+
+def test_follower_http_proxies_to_leader(tmp_path):
+    masters, urls = _start_masters(tmp_path)
+    vs = None
+    try:
+        leader = _wait_for(lambda: _leader_of(masters), what="a leader")
+        d = tmp_path / "vol"
+        d.mkdir()
+        vs = VolumeServer(master_url=",".join(urls), directories=[str(d)],
+                          port=free_port_pair(), max_volume_counts=[10],
+                          pulse_seconds=0.2)
+        vs.start()
+        _wait_for(lambda: len(leader.topo.nodes()) == 1,
+                  what="volume server registration")
+        follower = next(m for m in masters if m is not leader)
+        with urllib.request.urlopen(
+                f"http://{follower.url}/dir/assign", timeout=10) as r:
+            resp = json.load(r)
+        assert "fid" in resp, resp
+        # cluster status is answered locally and reports the leader
+        with urllib.request.urlopen(
+                f"http://{follower.url}/cluster/status", timeout=5) as r:
+            st = json.load(r)
+        assert st["IsLeader"] is False
+        assert st["Leader"] == leader.url
+    finally:
+        if vs is not None:
+            vs.stop()
+        for m in masters:
+            m.stop()
+
+
+def test_kill_leader_assigns_keep_working(tmp_path):
+    """The VERDICT's acceptance test: 3 masters, kill the leader,
+    assigns keep working after failover (volume server re-heartbeats to
+    the new leader on its own)."""
+    masters, urls = _start_masters(tmp_path)
+    vs = None
+    try:
+        leader = _wait_for(lambda: _leader_of(masters), what="a leader")
+        d = tmp_path / "vol"
+        d.mkdir()
+        vs = VolumeServer(master_url=",".join(urls), directories=[str(d)],
+                          port=free_port_pair(), max_volume_counts=[10],
+                          pulse_seconds=0.2)
+        vs.start()
+        _wait_for(lambda: len(leader.topo.nodes()) == 1,
+                  what="volume server registration")
+        with urllib.request.urlopen(
+                f"http://{leader.url}/dir/assign", timeout=10) as r:
+            first = json.load(r)
+        assert "fid" in first, first
+        first_vid = int(first["fid"].split(",")[0])
+
+        leader.stop()
+        survivors = [m for m in masters if m is not leader]
+        new_leader = _wait_for(lambda: _leader_of(survivors),
+                               what="failover leader")
+        # volume server finds the new leader via redirect/rotation
+        _wait_for(lambda: len(new_leader.topo.nodes()) == 1,
+                  timeout=20, what="re-heartbeat to new leader")
+        with urllib.request.urlopen(
+                f"http://{new_leader.url}/dir/assign", timeout=10) as r:
+            second = json.load(r)
+        assert "fid" in second, second
+        # the new leader never re-issues vids from before the failover:
+        # the pre-failover max volume id was raft-committed at grow time
+        assert new_leader.topo.next_volume_id > first_vid
+    finally:
+        if vs is not None:
+            vs.stop()
+        for m in masters:
+            m.stop()
+
+
+def test_log_compaction_and_snapshot_catchup(tmp_path):
+    """The raft log compacts into a snapshot past LOG_CAP, and a
+    far-behind (restarted) follower catches up via the piggybacked
+    snapshot instead of entry-by-entry replay."""
+    from seaweedfs_tpu.server.raft import RaftNode
+
+    masters, urls = _start_masters(tmp_path)
+    try:
+        leader = _wait_for(lambda: _leader_of(masters), what="a leader")
+        leader.raft.LOG_CAP = 8  # force compaction quickly
+        for m in masters:
+            m.raft.LOG_CAP = 8
+        for i in range(1, 30):
+            leader.raft.propose({"op": "max_volume_id", "value": i})
+        assert len(leader.raft.log) <= 9
+        assert leader.raft.snapshot_state.get("max_volume_id", 0) > 0
+        _wait_for(lambda: all(m.topo.next_volume_id >= 30 for m in masters),
+                  what="replication through compaction")
+        # restart a follower with wiped state: it must catch up from
+        # the leader's snapshot (its log base is beyond entry 1)
+        follower = next(m for m in masters if m is not leader)
+        fidx = masters.index(follower)
+        follower.stop()
+        import shutil
+        shutil.rmtree(tmp_path / f"m{fidx}")
+        m2 = MasterServer(port=int(follower.url.split(":")[1]),
+                          meta_dir=str(tmp_path / f"m{fidx}"),
+                          peers=urls, pulse_seconds=0.2,
+                          raft_election_timeout=0.25)
+        m2.raft.LOG_CAP = 8
+        m2.start()
+        masters[fidx] = m2
+        _wait_for(lambda: m2.topo.next_volume_id >= 30, timeout=20,
+                  what="snapshot catch-up on the wiped follower")
+    finally:
+        for m in masters:
+            m.stop()
