@@ -65,6 +65,7 @@ def _master_parser() -> argparse.ArgumentParser:
 
 def _build_master(opts):
     from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.util import config as config_mod
     if opts.mdir:
         os.makedirs(opts.mdir, exist_ok=True)
     peers = [x.strip() for x in (opts.peers or "").split(",") if x.strip()]
@@ -73,6 +74,9 @@ def _build_master(opts):
         # tie (command/master.go:167-196)
         log.warning("master count %d is even; raft needs an odd number "
                     "of peers to avoid split votes", len(peers))
+    conf = config_mod.load_configuration("master")
+    scripts = conf.get("master.maintenance.scripts") or []
+    sleep_minutes = conf.get("master.maintenance.sleep_minutes", 17)
     return MasterServer(
         ip=opts.ip, port=opts.port, meta_dir=opts.mdir,
         volume_size_limit_mb=opts.volume_size_limit_mb,
@@ -80,6 +84,8 @@ def _build_master(opts):
         pulse_seconds=opts.pulse_seconds,
         garbage_threshold=opts.garbage_threshold,
         peers=peers,
+        maintenance_scripts=list(scripts),
+        maintenance_interval_s=float(sleep_minutes) * 60,
     )
 
 

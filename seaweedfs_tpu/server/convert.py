@@ -51,6 +51,7 @@ def volume_info_from_pb(m: master_pb2.VolumeInformationMessage) -> dict:
         "replica_placement": m.replica_placement,
         "version": m.version or 3,
         "ttl": ttl_from_int(m.ttl),
+        "modified_at_second": m.modified_at_second,
     }
 
 

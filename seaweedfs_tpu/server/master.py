@@ -75,7 +75,9 @@ class MasterServer:
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
                  peers: Optional[List[str]] = None,
-                 raft_election_timeout: float = 0.5):
+                 raft_election_timeout: float = 0.5,
+                 maintenance_scripts: Optional[List[str]] = None,
+                 maintenance_interval_s: float = 17 * 60):
         self.ip = ip
         self.port = port
         self.meta_dir = meta_dir
@@ -110,6 +112,13 @@ class MasterServer:
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
         self._stopping = False
+        # leader-only admin-script cron (reference
+        # master_server.go:187-263 startAdminScripts; defaults come
+        # from the master.toml scaffold, scaffold.go:422-433)
+        self.maintenance_scripts = maintenance_scripts or []
+        self.maintenance_interval_s = maintenance_interval_s
+        self._maint_thread: Optional[threading.Thread] = None
+        self._maint_wake = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -132,12 +141,18 @@ class MasterServer:
             target=self._http_server.serve_forever, name="master-http",
             daemon=True)
         self._http_thread.start()
+        if self.maintenance_scripts:
+            self._maint_thread = threading.Thread(
+                target=self._maintenance_loop, name="master-maintenance",
+                daemon=True)
+            self._maint_thread.start()
         log.info("master %s started (grpc :%d)", self.url,
                  self.port + rpc.GRPC_PORT_OFFSET)
 
     def stop(self) -> None:
         log.info("master %s stopping", self.url)
         self._stopping = True
+        self._maint_wake.set()
         self.raft.stop()
         self._save_sequence()
         if self._http_server:
@@ -165,6 +180,42 @@ class MasterServer:
             with open(tmp, "w") as f:
                 json.dump({"next": self.topo.sequence.peek}, f)
             os.replace(tmp, p)
+
+    # -- maintenance cron ------------------------------------------------------
+
+    def _maintenance_loop(self) -> None:
+        """Leader-only: run the configured shell scripts every
+        interval, so EC encode/rebuild/balance and vacuum happen with
+        no operator action (reference master_server.go:187-263)."""
+        from seaweedfs_tpu.shell import CommandError, Shell
+        while not self._stopping:
+            self._maint_wake.wait(timeout=self.maintenance_interval_s)
+            self._maint_wake.clear()
+            if self._stopping:
+                return
+            if not self.raft.is_leader:
+                continue
+            sh = Shell(self.url)
+            for script in self.maintenance_scripts:
+                if self._stopping:
+                    return
+                if not self.raft.is_leader:
+                    log.info("maintenance: lost leadership mid-pass; "
+                             "aborting remaining scripts")
+                    break
+                try:
+                    out = sh.run_command(script)
+                    if out.strip():
+                        log.info("maintenance %r:\n%s", script,
+                                 out.strip())
+                except CommandError as e:
+                    log.warning("maintenance %r failed: %s", script, e)
+                except Exception:
+                    log.exception("maintenance %r crashed", script)
+
+    def run_maintenance_now(self) -> None:
+        """Test/ops hook: trigger one cron pass immediately."""
+        self._maint_wake.set()
 
     # -- raft ------------------------------------------------------------------
 
@@ -533,6 +584,13 @@ class MasterServer:
         return master_pb2.GetMasterConfigurationResponse()
 
     def LeaseAdminToken(self, request, context):
+        if not self.raft.is_leader:
+            # the cluster-wide lock lives on the raft leader only —
+            # leasing from a follower/deposed leader would give two
+            # holders (reference: exclusive locks ride the leader)
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not the raft leader; leader is "
+                          f"{self.raft.leader() or '?'}")
         try:
             token, ts = self.admin_lock.lease(request.previous_token)
         except PermissionError as e:

@@ -25,13 +25,16 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
     p.add_argument("-volumeId", type=int, default=0)
     p.add_argument("-collection", default="")
     p.add_argument("-fullPercent", type=float, default=95.0)
+    p.add_argument("-quietFor", default="0", type=parse_duration,
+                   help="only encode volumes idle this long (e.g. 1h)")
     p.add_argument("-encoder", default="",
                    help="tpu|jax|native|numpy|auto (kernel for the encode)")
     args = p.parse_args(argv)
     encoder = {"tpu": "jax"}.get(args.encoder, args.encoder)
 
     vids = [args.volumeId] if args.volumeId else \
-        _collect_full_volumes(env, args.collection, args.fullPercent)
+        _collect_full_volumes(env, args.collection, args.fullPercent,
+                              args.quietFor)
     if not vids:
         out.write("no volumes to encode\n")
         return
@@ -49,13 +52,41 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
         env.release_lock()
 
 
+def parse_duration(text: str) -> float:
+    """Go-style duration -> seconds: '90', '90s', '15m', '1h', '1h30m',
+    '100ms'. Raises ValueError on anything unrecognized — silently
+    treating garbage as 0 would disable quietFor write-protection."""
+    import re
+    text = (text or "0").strip().lower()
+    if re.fullmatch(r"\d+(\.\d+)?", text):
+        return float(text)
+    total = 0.0
+    pos = 0
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ms|h|m|s)", text):
+        if m.start() != pos:
+            raise ValueError(f"bad duration {text!r}")
+        total += float(m.group(1)) * \
+            {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
+        pos = m.end()
+    if pos != len(text):
+        raise ValueError(f"bad duration {text!r}")
+    return total
+
+
 def _collect_full_volumes(env: CommandEnv, collection: str,
-                          full_percent: float) -> List[int]:
+                          full_percent: float,
+                          quiet_for_s: float = 0.0) -> List[int]:
+    import time as _time
     limit = env.volume_size_limit()
     vids = []
     for vid, replicas in env.collect_volume_replicas().items():
         info = replicas[0].info
         if collection and info.collection != collection:
+            continue
+        if quiet_for_s and info.modified_at_second and \
+                _time.time() - info.modified_at_second < quiet_for_s:
+            # still being written: leave it alone (reference
+            # collectVolumeIdsForEcEncode quietPeriod check)
             continue
         if info.size >= limit * full_percent / 100.0:
             vids.append(vid)

@@ -33,6 +33,7 @@ class CommandEnv:
     def __init__(self, master_url: str):
         self.master_url = master_url
         self._lock_token = 0
+        self._lock_depth = 0
 
     @property
     def master(self):
@@ -44,17 +45,27 @@ class CommandEnv:
     # -- admin lock ----------------------------------------------------------
 
     def acquire_lock(self) -> None:
+        """Lease (or renew) the cluster admin lock. Nestable: an
+        explicit `lock` shell command brackets a script list, and each
+        command's own acquire/release pair must renew rather than drop
+        the outer bracket (reference exclusive_locker renews one
+        long-lived lease the same way)."""
         resp = self.master.LeaseAdminToken(
             master_pb2.LeaseAdminTokenRequest(
                 previous_token=self._lock_token, lock_name="admin"))
         self._lock_token = resp.token
+        self._lock_depth += 1
 
     def release_lock(self) -> None:
-        if self._lock_token:
-            self.master.ReleaseAdminToken(
-                master_pb2.ReleaseAdminTokenRequest(
-                    previous_token=self._lock_token))
-            self._lock_token = 0
+        if not self._lock_token:
+            return
+        self._lock_depth -= 1
+        if self._lock_depth > 0:
+            return  # still bracketed by an outer `lock`
+        self.master.ReleaseAdminToken(
+            master_pb2.ReleaseAdminTokenRequest(
+                previous_token=self._lock_token))
+        self._lock_token = 0
 
     # -- topology snapshot ----------------------------------------------------
 

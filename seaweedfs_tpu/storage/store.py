@@ -139,6 +139,8 @@ class Store:
             "replica_placement": v.replica_placement.to_byte(),
             "ttl": str(v.ttl),
             "version": v.version,
+            "modified_at_second": max(v.last_modified_ts,
+                                      v.last_append_at_ns // 1_000_000_000),
         }
 
     def collect_heartbeat(self) -> dict:

@@ -231,6 +231,37 @@ class Volume:
         self.nm = NeedleMap(self.idx_path)
         if not self._dat.is_remote:
             self._check_and_fix_integrity()
+        self._restore_last_append_ns()
+
+    def _restore_last_append_ns(self) -> None:
+        """Recover the newest record's appendAtNs from the last .idx
+        entry (the reference reads lastAppendAtNs at load too) — the
+        quiet-period guard in ec.encode and incremental backup both
+        depend on it surviving a restart."""
+        import struct
+        if not os.path.exists(self.idx_path):
+            return
+        size = os.path.getsize(self.idx_path)
+        n_entries = size // t.NEEDLE_MAP_ENTRY_SIZE
+        if n_entries == 0:
+            return
+        with open(self.idx_path, "rb") as f:
+            f.seek((n_entries - 1) * t.NEEDLE_MAP_ENTRY_SIZE)
+            entry = f.read(t.NEEDLE_MAP_ENTRY_SIZE)
+        _, offset, _ = idx_codec.parse_entry(entry)
+        header = self._dat.read_at(t.NEEDLE_HEADER_SIZE, offset)
+        if len(header) < t.NEEDLE_HEADER_SIZE:
+            return
+        _, _, size_u = struct.unpack(">IQI", header)
+        body = t.size_to_int32(size_u)
+        if t.size_is_deleted(body):
+            body = 0
+        ts_off = offset + t.NEEDLE_HEADER_SIZE + body + \
+            t.NEEDLE_CHECKSUM_SIZE
+        blob = self._dat.read_at(8, ts_off)
+        if len(blob) == 8:
+            self.last_append_at_ns = struct.unpack(">Q", blob)[0]
+            self.last_modified_ts = self.last_append_at_ns // 1_000_000_000
 
     def _check_and_fix_integrity(self) -> None:
         """Truncate a torn tail: the .dat must end exactly after the last

@@ -21,12 +21,13 @@ class VolumeInfo:
 
     __slots__ = ("id", "collection", "size", "file_count", "delete_count",
                  "deleted_byte_count", "read_only", "replica_placement",
-                 "ttl", "version")
+                 "ttl", "version", "modified_at_second")
 
     def __init__(self, id: int, collection: str = "", size: int = 0,
                  file_count: int = 0, delete_count: int = 0,
                  deleted_byte_count: int = 0, read_only: bool = False,
                  replica_placement: int = 0, ttl: str = "", version: int = 3,
+                 modified_at_second: int = 0,
                  **_ignored):
         self.id = id
         self.collection = collection
@@ -38,6 +39,7 @@ class VolumeInfo:
         self.replica_placement = replica_placement
         self.ttl = ttl
         self.version = version
+        self.modified_at_second = modified_at_second
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
