@@ -168,7 +168,8 @@ def _filer_parser() -> argparse.ArgumentParser:
     p.add_argument("-port", type=int, default=8888)
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-store", default="sqlite",
-                   help="metadata store: memory | sqlite")
+                   help="metadata store: memory | sqlite | weedkv "
+                        "(embedded log-structured KV)")
     p.add_argument("-dir", default="./filer",
                    help="directory for metadata store + event log")
     p.add_argument("-collection", default="")

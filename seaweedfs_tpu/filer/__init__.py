@@ -5,5 +5,6 @@ from seaweedfs_tpu.filer.filer import Filer, FilerError  # noqa: F401
 from seaweedfs_tpu.filer.filerstore import (  # noqa: F401
     FilerStore, FilerStoreWrapper, NotFound,
 )
+from seaweedfs_tpu.filer.stores.kv_store import KvFilerStore, LogKV  # noqa: F401,E501
 from seaweedfs_tpu.filer.stores.memory_store import MemoryStore  # noqa: F401
 from seaweedfs_tpu.filer.stores.sqlite_store import SqliteStore  # noqa: F401

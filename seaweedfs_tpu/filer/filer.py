@@ -246,8 +246,14 @@ class Filer:
                 join_path(directory, name), recursive,
                 ignore_recursive_error))
             self.store.delete_folder_children(join_path(directory, name))
-        chunks.extend(entry.chunks)
         self.store.delete_entry(directory, name)
+        # hardlinked entries share their chunks: the wrapper just
+        # dropped this link's reference — only the LAST unlink may
+        # delete the data (reference filer_delete_entry.go checks the
+        # hard link counter the same way)
+        if not entry.hard_link_id or \
+                self.store.hardlink_counter(entry.hard_link_id) == 0:
+            chunks.extend(entry.chunks)
         self._notify(directory, entry, None, delete_chunks=delete_data,
                      from_other_cluster=from_other_cluster)
         if delete_data and chunks:
@@ -269,7 +275,14 @@ class Filer:
                 except FilerError:
                     if not ignore_error:
                         raise
-            chunks.extend(c.chunks)
+                chunks.extend(c.chunks)
+            elif c.hard_link_id:
+                # folder wipe bypasses per-entry deletes: account the
+                # link here, and reclaim chunks only on the last one
+                if self.store.release_hardlink(c.hard_link_id) == 0:
+                    chunks.extend(c.chunks)
+            else:
+                chunks.extend(c.chunks)
         return chunks
 
     # -- rename ---------------------------------------------------------------

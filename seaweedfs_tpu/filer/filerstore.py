@@ -94,9 +94,16 @@ class FilerStore:
         pass
 
 
+HARD_LINK_MARKER = b"\x01hardlink\x00"
+
+
 class FilerStoreWrapper(FilerStore):
-    """Counts ops per store like filerstore_wrapper.go; single place to
-    add path-prefix translation later."""
+    """Counts ops per store (filerstore_wrapper.go) and resolves
+    hardlinked entries (filerstore_hardlink.go): directory entries with
+    a hard_link_id are stored as stubs; the shared metadata (chunks,
+    attributes, link counter) lives once in the store's KV space, so
+    every link sees one consistent inode and the last unlink reclaims
+    it."""
 
     def __init__(self, store: FilerStore):
         self.store = store
@@ -105,20 +112,127 @@ class FilerStoreWrapper(FilerStore):
     def _count(self, op: str):
         FilerStoreCounter.labels(self.name, op).inc()
 
+    # -- hardlink plumbing ---------------------------------------------------
+
+    @staticmethod
+    def _hl_key(hard_link_id: bytes) -> bytes:
+        return HARD_LINK_MARKER + bytes(hard_link_id)
+
+    def _read_hl_meta(self, hard_link_id: bytes):
+        blob = self.store.kv_get(self._hl_key(hard_link_id))
+        if not blob:  # absent or reclaimed (empty tombstone)
+            return None
+        meta = filer_pb2.Entry()
+        meta.ParseFromString(blob)
+        return meta
+
+    def _write_hardlink(self, directory, entry) -> None:
+        """Store shared meta in KV, a stub in the directory
+        (filerstore_hardlink.go maybeUpdateHardLink)."""
+        meta = self._read_hl_meta(entry.hard_link_id)
+        counter = meta.hard_link_counter if meta is not None else 0
+        try:
+            existing = self.store.find_entry(directory, entry.name)
+            is_new_link = bytes(existing.hard_link_id) != \
+                bytes(entry.hard_link_id)
+        except NotFound:
+            is_new_link = True
+        full = filer_pb2.Entry()
+        full.CopyFrom(entry)
+        full.hard_link_counter = counter + 1 if is_new_link else \
+            max(counter, 1)
+        self.store.kv_put(self._hl_key(entry.hard_link_id),
+                          full.SerializeToString())
+        stub = filer_pb2.Entry(name=entry.name,
+                               is_directory=entry.is_directory,
+                               hard_link_id=bytes(entry.hard_link_id))
+        self.store.insert_entry(directory, stub)
+
+    def hardlink_counter(self, hard_link_id: bytes) -> int:
+        meta = self._read_hl_meta(hard_link_id)
+        return meta.hard_link_counter if meta is not None else 0
+
+    def release_hardlink(self, hard_link_id: bytes) -> int:
+        """Drop one reference; reclaim the shared meta at zero.
+        Returns the remaining counter."""
+        meta = self._read_hl_meta(hard_link_id)
+        if meta is None:
+            return 0
+        meta.hard_link_counter -= 1
+        if meta.hard_link_counter <= 0:
+            self.store.kv_put(self._hl_key(hard_link_id), b"")
+            return 0
+        self.store.kv_put(self._hl_key(hard_link_id),
+                          meta.SerializeToString())
+        return meta.hard_link_counter
+
+    def _resolve(self, entry):
+        if entry is None or not entry.hard_link_id:
+            return entry
+        meta = self._read_hl_meta(entry.hard_link_id)
+        if meta is None:
+            return entry  # dangling link: serve the stub
+        resolved = filer_pb2.Entry()
+        resolved.CopyFrom(meta)
+        resolved.name = entry.name
+        return resolved
+
+    # -- SPI -----------------------------------------------------------------
+
     def insert_entry(self, directory, entry):
         self._count("insert")
-        self.store.insert_entry(directory, entry)
+        # replacing a stub that pointed at a DIFFERENT link must drop
+        # that link's reference, or its shared meta leaks forever
+        try:
+            old = self.store.find_entry(directory, entry.name)
+        except NotFound:
+            old = None
+        if old is not None and old.hard_link_id and \
+                bytes(old.hard_link_id) != bytes(entry.hard_link_id):
+            self.release_hardlink(old.hard_link_id)
+        if entry.hard_link_id:
+            self._write_hardlink(directory, entry)
+        else:
+            self.store.insert_entry(directory, entry)
 
     def update_entry(self, directory, entry):
         self._count("update")
-        self.store.update_entry(directory, entry)
+        try:
+            old = self.store.find_entry(directory, entry.name)
+        except NotFound:
+            old = None
+        if old is not None and old.hard_link_id and \
+                bytes(old.hard_link_id) != bytes(entry.hard_link_id):
+            self.release_hardlink(old.hard_link_id)
+        if entry.hard_link_id:
+            meta = self._read_hl_meta(entry.hard_link_id)
+            full = filer_pb2.Entry()
+            full.CopyFrom(entry)
+            full.hard_link_counter = meta.hard_link_counter \
+                if meta is not None else 1
+            self.store.kv_put(self._hl_key(entry.hard_link_id),
+                              full.SerializeToString())
+            # the directory record must become a stub too, or this path
+            # keeps serving (and later deleting) its pre-link content
+            stub = filer_pb2.Entry(name=entry.name,
+                                   is_directory=entry.is_directory,
+                                   hard_link_id=bytes(entry.hard_link_id))
+            self.store.insert_entry(directory, stub)
+        else:
+            self.store.update_entry(directory, entry)
 
     def find_entry(self, directory, name):
         self._count("find")
-        return self.store.find_entry(directory, name)
+        return self._resolve(self.store.find_entry(directory, name))
 
     def delete_entry(self, directory, name):
         self._count("delete")
+        try:
+            raw = self.store.find_entry(directory, name)
+        except NotFound:
+            raw = None
+        if raw is not None and raw.hard_link_id:
+            self.release_hardlink(raw.hard_link_id)
         self.store.delete_entry(directory, name)
 
     def delete_folder_children(self, directory):
@@ -128,8 +242,8 @@ class FilerStoreWrapper(FilerStore):
     def list_directory_entries(self, directory, start_name="",
                                inclusive=False, limit=1024, prefix=""):
         self._count("list")
-        return self.store.list_directory_entries(
-            directory, start_name, inclusive, limit, prefix)
+        return [self._resolve(e) for e in self.store.list_directory_entries(
+            directory, start_name, inclusive, limit, prefix)]
 
     def begin_transaction(self):
         self.store.begin_transaction()

@@ -37,11 +37,13 @@ def assign(master_url: str, count: int = 1, replication: str = "",
 
 def upload_data(url_fid: str, data: bytes, filename: str = "",
                 mime: str = "", ttl: str = "", gzip: bool = False,
-                timeout: float = 60.0) -> dict:
+                fsync: bool = False, timeout: float = 60.0) -> dict:
     """POST a blob to "host:port/fid". Optionally gzip-compresses."""
     params = {}
     if ttl:
         params["ttl"] = ttl
+    if fsync:
+        params["fsync"] = "true"
     qs = ("?" + urllib.parse.urlencode(params)) if params else ""
     headers = {}
     if gzip:

@@ -23,6 +23,7 @@ from seaweedfs_tpu import rpc
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.filer import (Filer, FilerError, MemoryStore, NotFound,
                                  SqliteStore, filechunks, stream)
+from seaweedfs_tpu.filer import filer_conf as filer_conf_mod
 from seaweedfs_tpu.filer.filechunk_manifest import maybe_manifestize
 from seaweedfs_tpu.filer.filer import entry_expired, new_entry
 from seaweedfs_tpu.filer.filerstore import join_path, split_path
@@ -59,6 +60,11 @@ class FilerServer:
         elif store == "sqlite":
             path = f"{meta_dir}/filer.db" if meta_dir else ":memory:"
             backend = SqliteStore(path)
+        elif store in ("weedkv", "kv", "leveldb"):
+            from seaweedfs_tpu.filer.stores.kv_store import KvFilerStore
+            if not meta_dir:
+                raise ValueError("weedkv store needs a -dir/meta_dir")
+            backend = KvFilerStore(f"{meta_dir}/weedkv")
         else:
             raise ValueError(f"unknown filer store {store!r}")
         self.filer = Filer(backend,
@@ -71,10 +77,35 @@ class FilerServer:
             disk_dir=f"{cache_dir}/chunks" if cache_dir else None)
         self.master_client = MasterClient(
             [master_url], client_name=f"filer@{ip}:{port}")
+        # path-specific rules (/etc/seaweedfs/filer.conf inside the
+        # namespace; reference filer_conf.go) — loaded lazily, reloaded
+        # whenever that path is written through this filer
+        self.filer_conf = filer_conf_mod.FilerConf()
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
         self._stopping = False
+
+    def _maybe_reload_conf(self, *paths: str) -> None:
+        if filer_conf_mod.FILER_CONF_PATH in paths:
+            self.reload_filer_conf()
+
+    def reload_filer_conf(self) -> None:
+        """(Re)read /etc/seaweedfs/filer.conf from the namespace
+        (reference filer_conf.go loadConfiguration)."""
+        try:
+            entry = self.filer.find_entry(filer_conf_mod.FILER_CONF_PATH)
+        except NotFound:
+            self.filer_conf = filer_conf_mod.FilerConf()
+            return
+        try:
+            blob = b"".join(stream.stream_content(
+                self.lookup_fid_urls, list(entry.chunks)))
+            self.filer_conf = filer_conf_mod.FilerConf.from_bytes(blob)
+            log.info("filer conf loaded: %d path rules",
+                     len(self.filer_conf.rules))
+        except Exception as e:
+            log.warning("filer conf unreadable, keeping previous: %s", e)
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -93,6 +124,7 @@ class FilerServer:
             name=f"filer-http-{self.port}", daemon=True)
         self._http_thread.start()
         self.master_client.start()
+        self.reload_filer_conf()
         log.info("filer %s:%d started (store=%s, master=%s)",
                  self.ip, self.port, type(self.filer.store).__name__,
                  self.master_url)
@@ -141,7 +173,8 @@ class FilerServer:
 
     def upload_to_chunks(self, data: bytes, collection: str = "",
                          replication: str = "", ttl_sec: int = 0,
-                         mime: str = "") -> List[filer_pb2.FileChunk]:
+                         mime: str = "",
+                         fsync: bool = False) -> List[filer_pb2.FileChunk]:
         """Split `data` into chunkSize pieces, assign+upload each
         (reference uploadReaderToChunks)."""
         chunks: List[filer_pb2.FileChunk] = []
@@ -153,7 +186,7 @@ class FilerServer:
                 stored, cipher_key = encrypt(piece)
             a = self._assign(collection, replication, ttl_sec)
             resp = operations.upload_data(
-                f"{a.url}/{a.fid}", stored, mime=mime)
+                f"{a.url}/{a.fid}", stored, mime=mime, fsync=fsync)
             chunks.append(filer_pb2.FileChunk(
                 file_id=a.fid, offset=off, size=len(piece),
                 mtime=time.time_ns(), e_tag=resp.get("eTag", ""),
@@ -196,6 +229,8 @@ class FilerServer:
             self.filer.create_entry(
                 request.directory, request.entry, o_excl=request.o_excl,
                 from_other_cluster=request.is_from_other_cluster)
+            self._maybe_reload_conf(
+                join_path(request.directory, request.entry.name))
             return filer_pb2.CreateEntryResponse()
         except FilerError as e:
             return filer_pb2.CreateEntryResponse(error=str(e))
@@ -204,6 +239,8 @@ class FilerServer:
         self.filer.update_entry(
             request.directory, request.entry,
             from_other_cluster=request.is_from_other_cluster)
+        self._maybe_reload_conf(
+            join_path(request.directory, request.entry.name))
         return filer_pb2.UpdateEntryResponse()
 
     def AppendToEntry(self, request, context):
@@ -220,6 +257,8 @@ class FilerServer:
                 ignore_recursive_error=request.ignore_recursive_error,
                 delete_data=request.is_delete_data,
                 from_other_cluster=request.is_from_other_cluster)
+            self._maybe_reload_conf(
+                join_path(request.directory, request.name))
             return filer_pb2.DeleteEntryResponse()
         except FilerError as e:
             return filer_pb2.DeleteEntryResponse(error=str(e))
@@ -232,6 +271,9 @@ class FilerServer:
         except NotFound:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"{request.old_directory}/{request.old_name}")
+        self._maybe_reload_conf(
+            join_path(request.old_directory, request.old_name),
+            join_path(request.new_directory, request.new_name))
         return filer_pb2.AtomicRenameEntryResponse()
 
     # -- gRPC: volume plumbing ------------------------------------------------
@@ -475,15 +517,23 @@ def _make_http_handler(fs: FilerServer):
                 return
             collection = params.get("collection", [""])[0]
             replication = params.get("replication", [""])[0]
+            ttl_param = params.get("ttl", [""])[0]
+            rule = fs.filer_conf.match(join_path(directory, name))
+            fsync = "fsync" in params
+            if rule is not None:
+                collection = collection or rule.collection
+                replication = replication or rule.replication
+                ttl_param = ttl_param or rule.ttl
+                fsync = fsync or rule.fsync
             try:
-                ttl_sec = _parse_ttl_seconds(params.get("ttl", [""])[0])
+                ttl_sec = _parse_ttl_seconds(ttl_param)
             except ValueError:
                 self._json({"error": "bad ttl"}, code=400)
                 return
             try:
                 chunks = fs.upload_to_chunks(
                     data, collection=collection, replication=replication,
-                    ttl_sec=ttl_sec, mime=mime)
+                    ttl_sec=ttl_sec, mime=mime, fsync=fsync)
                 chunks = maybe_manifestize(fs.save_manifest_blob, chunks)
             except (RuntimeError, OSError) as e:
                 self._json({"error": str(e)}, code=500)
@@ -499,6 +549,7 @@ def _make_http_handler(fs: FilerServer):
             except FilerError as e:
                 self._json({"error": str(e)}, code=500)
                 return
+            fs._maybe_reload_conf(join_path(directory, name))
             self._json({"name": name, "size": len(data)}, code=201,
                        headers={"ETag": filechunks.etag_of_chunks(chunks)})
 

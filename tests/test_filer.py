@@ -12,10 +12,13 @@ from seaweedfs_tpu.filer.filerstore import join_path, split_path
 from seaweedfs_tpu.pb import filer_pb2
 
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite-file"])
+@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
+    elif request.param == "weedkv":
+        from seaweedfs_tpu.filer import KvFilerStore
+        s = KvFilerStore(str(tmp_path / "weedkv"))
     elif request.param == "sqlite":
         s = SqliteStore()
     else:

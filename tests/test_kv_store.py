@@ -1,0 +1,270 @@
+"""LogKV engine, hardlink wrapper, and path-conf tests (VERDICT
+round-1 item 9: leveldb-class embedded filer store + wrapper layers).
+
+Reference: weed/filer/leveldb/leveldb_store.go (engine role),
+filerstore_hardlink.go (shared-inode links), filer_conf.go (per-path
+rules).
+"""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.filer import KvFilerStore, LogKV, NotFound
+from seaweedfs_tpu.filer.filer_conf import FILER_CONF_PATH, FilerConf, PathConf
+from seaweedfs_tpu.filer.filerstore import FilerStoreWrapper
+from seaweedfs_tpu.filer.stores.memory_store import MemoryStore
+from seaweedfs_tpu.pb import filer_pb2
+
+
+# -- LogKV engine --------------------------------------------------------------
+
+
+def test_logkv_put_get_delete_persist(tmp_path):
+    kv = LogKV(str(tmp_path))
+    kv.put(b"a", b"1")
+    kv.put(b"b", b"2")
+    kv.put(b"a", b"1-updated")
+    kv.delete(b"b")
+    assert kv.get(b"a") == b"1-updated"
+    assert kv.get(b"b") is None
+    kv.close()
+    # replay from disk
+    kv2 = LogKV(str(tmp_path))
+    assert kv2.get(b"a") == b"1-updated"
+    assert kv2.get(b"b") is None
+    assert len(kv2) == 1
+    kv2.close()
+
+
+def test_logkv_ordered_prefix_scan(tmp_path):
+    kv = LogKV(str(tmp_path))
+    for k in (b"p/c", b"p/a", b"q/x", b"p/b", b"pp"):
+        kv.put(k, b"v" + k)
+    got = [k for k, _ in kv.scan(b"p/")]
+    assert got == [b"p/a", b"p/b", b"p/c"]
+    # from a start key, exclusive
+    got = [k for k, _ in kv.scan(b"p/", start=b"p/a", inclusive=False)]
+    assert got == [b"p/b", b"p/c"]
+    kv.close()
+
+
+def test_logkv_compaction_reclaims_garbage(tmp_path):
+    kv = LogKV(str(tmp_path))
+    kv.COMPACT_MIN_BYTES = 1  # compact aggressively
+    for i in range(200):
+        kv.put(b"key", b"v" * 100)  # same key: 199 garbage records
+    assert kv.get(b"key") == b"v" * 100
+    assert kv._total_bytes < 3 * kv._live_bytes
+    # still correct after reopen
+    kv.close()
+    kv2 = LogKV(str(tmp_path))
+    assert kv2.get(b"key") == b"v" * 100
+    kv2.close()
+
+
+def test_logkv_torn_tail_tolerated(tmp_path):
+    kv = LogKV(str(tmp_path))
+    kv.put(b"good", b"data")
+    kv.close()
+    # simulate a crash mid-append: garbage bytes at the log tail
+    seg = sorted(p for p in os.listdir(tmp_path) if p.endswith(".wlog"))[-1]
+    with open(tmp_path / seg, "ab") as f:
+        f.write(b"\x01\x00\x00")  # truncated header
+    kv2 = LogKV(str(tmp_path))
+    assert kv2.get(b"good") == b"data"
+    # and new writes after recovery survive the NEXT replay
+    kv2.put(b"after", b"crash")
+    kv2.close()
+    kv3 = LogKV(str(tmp_path))
+    assert kv3.get(b"after") == b"crash"
+    kv3.close()
+
+
+def test_kv_filer_store_roundtrip(tmp_path):
+    s = KvFilerStore(str(tmp_path))
+    e = filer_pb2.Entry(name="f.txt")
+    e.attributes.file_size = 42
+    s.insert_entry("/dir", e)
+    got = s.find_entry("/dir", "f.txt")
+    assert got.attributes.file_size == 42
+    with pytest.raises(NotFound):
+        s.find_entry("/dir", "missing")
+    for n in ("a", "c", "b"):
+        s.insert_entry("/dir/sub", filer_pb2.Entry(name=n))
+    names = [x.name for x in s.list_directory_entries("/dir/sub")]
+    assert names == ["a", "b", "c"]
+    # delete_folder_children removes the subtree
+    s.delete_folder_children("/dir")
+    assert s.list_directory_entries("/dir/sub") == []
+    s.kv_put(b"k1", b"v1")
+    assert s.kv_get(b"k1") == b"v1"
+    s.close()
+
+
+# -- hardlink wrapper ----------------------------------------------------------
+
+
+def _hl_entry(name: str, link_id: bytes, size: int = 7) -> filer_pb2.Entry:
+    e = filer_pb2.Entry(name=name, hard_link_id=link_id)
+    e.attributes.file_size = size
+    e.chunks.add(file_id="3,ab1", size=size)
+    return e
+
+
+def test_hardlink_shared_inode_and_unlink(tmp_path):
+    w = FilerStoreWrapper(MemoryStore())
+    link_id = b"\x00\x01\x02\x03"
+    w.insert_entry("/d1", _hl_entry("one", link_id))
+    w.insert_entry("/d2", _hl_entry("two", link_id))
+    # both names resolve to the shared inode
+    a = w.find_entry("/d1", "one")
+    b = w.find_entry("/d2", "two")
+    assert a.attributes.file_size == 7 and b.attributes.file_size == 7
+    assert a.chunks[0].file_id == b.chunks[0].file_id == "3,ab1"
+    assert a.name == "one" and b.name == "two"
+    # an update through one link is visible through the other
+    upd = _hl_entry("one", link_id, size=99)
+    w.update_entry("/d1", upd)
+    assert w.find_entry("/d2", "two").attributes.file_size == 99
+    # listing resolves stubs too
+    listed = w.list_directory_entries("/d1")
+    assert listed[0].attributes.file_size == 99
+    # first unlink keeps the inode; the counter protects it
+    w.delete_entry("/d1", "one")
+    assert w.find_entry("/d2", "two").attributes.file_size == 99
+    # last unlink reclaims the shared meta
+    w.delete_entry("/d2", "two")
+    assert w._read_hl_meta(link_id) is None
+
+
+def test_hardlink_counter_not_bumped_on_overwrite():
+    w = FilerStoreWrapper(MemoryStore())
+    link_id = b"\x09\x09"
+    w.insert_entry("/d", _hl_entry("f", link_id))
+    w.insert_entry("/d", _hl_entry("f", link_id))  # overwrite, same link
+    meta = w._read_hl_meta(link_id)
+    assert meta.hard_link_counter == 1
+    w.delete_entry("/d", "f")
+    assert w._read_hl_meta(link_id) is None
+
+
+# -- filer conf ----------------------------------------------------------------
+
+
+def test_filer_conf_longest_prefix_match():
+    conf = FilerConf([
+        PathConf("/buckets/", collection="generic"),
+        PathConf("/buckets/important/", collection="gold",
+                 replication="001"),
+    ])
+    assert conf.match("/buckets/important/x").collection == "gold"
+    assert conf.match("/buckets/other/x").collection == "generic"
+    assert conf.match("/tmp/x") is None
+    # round-trips through bytes
+    again = FilerConf.from_bytes(conf.to_bytes())
+    assert again.match("/buckets/important/x").replication == "001"
+
+
+def test_filer_conf_applied_and_reloaded_live(tmp_path):
+    """Writing /etc/seaweedfs/filer.conf through the filer HTTP API
+    takes effect immediately: later writes under the rule's prefix pick
+    up its collection."""
+    import json
+    import urllib.request
+    from tests.cluster_util import Cluster
+
+    c = Cluster(tmp_path, n_volume_servers=1, with_filer=True)
+    try:
+        conf = FilerConf([PathConf("/hot/", collection="hotdata")])
+        req = urllib.request.Request(
+            f"http://{c.filer.url}{FILER_CONF_PATH}",
+            data=conf.to_bytes(), method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            json.load(r)
+        assert c.filer.filer_conf.match("/hot/a") is not None
+        req = urllib.request.Request(
+            f"http://{c.filer.url}/hot/a.txt", data=b"hello",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            json.load(r)
+        # the entry records the rule's collection
+        e = c.filer.filer.find_entry("/hot/a.txt")
+        assert e.attributes.collection == "hotdata"
+        # and the chunk actually landed in that collection
+        assert "hotdata" in [
+            col for col in _collections(c)], _collections(c)
+    finally:
+        c.stop()
+
+
+def _collections(c):
+    cols = set()
+    for vs in c.volume_servers:
+        for loc in vs.store.locations:
+            for v in loc.volumes.values():
+                cols.add(v.collection)
+    return cols
+
+
+def test_hardlink_unlink_keeps_shared_chunks(tmp_path):
+    """Deleting one link must NOT delete the shared chunks while other
+    links remain; the last unlink reclaims them (reference
+    filer_delete_entry.go hard-link counter check)."""
+    from seaweedfs_tpu.filer import Filer
+
+    deleted = []
+    f = Filer(MemoryStore())
+    f.on_delete_chunks = lambda chunks: deleted.extend(
+        c.file_id for c in chunks)
+    link_id = b"\x42\x42"
+    f.create_entry("/d1", _hl_entry("one", link_id))
+    f.create_entry("/d2", _hl_entry("two", link_id))
+    f.delete_entry("/d1/one")
+    assert deleted == []  # survivor still references 3,ab1
+    assert f.find_entry("/d2/two").attributes.file_size == 7
+    f.delete_entry("/d2/two")
+    assert deleted == ["3,ab1"]  # last unlink frees the data
+
+
+def test_hardlink_recursive_dir_delete_respects_links(tmp_path):
+    """rm -r of a directory holding one link of a pair must keep the
+    shared chunks alive and decrement the counter."""
+    from seaweedfs_tpu.filer import Filer
+
+    deleted = []
+    f = Filer(MemoryStore())
+    f.on_delete_chunks = lambda chunks: deleted.extend(
+        c.file_id for c in chunks)
+    link_id = b"\x43\x43"
+    f.create_entry("/dir/sub", filer_pb2.Entry(name="sub",
+                                               is_directory=True))
+    f.create_entry("/dir/sub", _hl_entry("link1", link_id))
+    f.create_entry("/other", _hl_entry("link2", link_id))
+    f.delete_entry("/dir", recursive=True)
+    assert deleted == []
+    assert f.find_entry("/other/link2").attributes.file_size == 7
+    # counter accounted: the remaining unlink reclaims
+    f.delete_entry("/other/link2")
+    assert deleted == ["3,ab1"]
+
+
+def test_hardlink_stub_overwrite_releases_old_link():
+    """Re-creating a link's name as a plain file releases that link's
+    reference, so the pair's last real unlink still reclaims."""
+    from seaweedfs_tpu.filer import Filer
+
+    deleted = []
+    f = Filer(MemoryStore())
+    f.on_delete_chunks = lambda chunks: deleted.extend(
+        c.file_id for c in chunks)
+    link_id = b"\x44\x44"
+    f.create_entry("/d", _hl_entry("f", link_id))
+    f.create_entry("/e", _hl_entry("g", link_id))
+    # overwrite /d/f with an unrelated plain file
+    plain = filer_pb2.Entry(name="f")
+    plain.chunks.add(file_id="9,ff0", size=3)
+    f.create_entry("/d", plain)
+    assert f.store.hardlink_counter(link_id) == 1
+    f.delete_entry("/e/g")  # last real link
+    assert "3,ab1" in deleted
