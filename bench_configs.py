@@ -1,0 +1,228 @@
+"""BASELINE.md config-matrix measurements (configs 1-5).
+
+Usage: python bench_configs.py [1|2|3|4|5|all]
+
+Each config prints one JSON line; results are recorded in BASELINE.md.
+Config definitions come from BASELINE.json / BASELINE.md:
+
+1. Single 1GB .dat, RS(10,4) ec.encode on CPU (native AVX2 backend —
+   the klauspost/reedsolomon stand-in) through the repo's own
+   write_ec_files path (file IO included).
+2. Sustained on-device jax encode (bench.py methodology: chained
+   full-parity dependence, >VMEM working set) + the same 1GB
+   write_ec_files end-to-end with backend=jax (includes host IO and
+   the axon tunnel's ~0.5 GB/s h2d, so it is tunnel-bound; noted).
+3. Rebuild with 2 missing shards: host rebuild_ec_files on the 1GB
+   volume (native), plus the on-device reconstruct kernel rate.
+4. 8-way sharded encode on a virtual CPU mesh (correctness +
+   scaling-shape check; per-chip GB/s comes from config 2 — multi-chip
+   hardware is not reachable from this image).
+5. Mixed workload: p99 needle-read latency while an ec.encode runs on
+   the same volume server, with the -compactionMBps throttler engaged
+   vs unthrottled vs idle.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+GB = 1 << 30
+DAT_SIZE = 1 * GB
+
+
+def _make_dat(path: str, size: int = DAT_SIZE) -> None:
+    """Synthetic .dat: 8B superblock + pseudo-random bytes (cheap:
+    tiled PCG block, content irrelevant to throughput)."""
+    rng = np.random.default_rng(7)
+    block = rng.integers(0, 256, 16 << 20, dtype=np.uint8).tobytes()
+    with open(path, "wb") as f:
+        f.write(b"\x03\x00\x00\x00\x00\x00\x00\x00")
+        written = 8
+        while written < size:
+            n = min(len(block), size - written)
+            f.write(block[:n])
+            written += n
+
+
+def _encode_once(base: str, backend: str) -> float:
+    from seaweedfs_tpu.ec import encoder
+    t0 = time.perf_counter()
+    encoder.write_ec_files(base, backend=backend)
+    return time.perf_counter() - t0
+
+
+def config1() -> dict:
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        _make_dat(base + ".dat")
+        dt = _encode_once(base, "native")
+        gbps = DAT_SIZE / GB / dt
+    return {"config": 1, "metric": "ec_encode_cpu_native_1gb",
+            "wall_s": round(dt, 2), "value": round(gbps, 3),
+            "unit": "GB/s"}
+
+
+def config2() -> dict:
+    # end-to-end 1GB through write_ec_files with the jax backend
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        _make_dat(base + ".dat")
+        dt = _encode_once(base, "jax")
+        e2e_gbps = DAT_SIZE / GB / dt
+    # sustained on-device rate: reuse bench.py (prints its own line)
+    import subprocess
+    out = subprocess.run([sys.executable, "bench.py"], cwd=os.path.dirname(
+        os.path.abspath(__file__)), capture_output=True, text=True,
+        timeout=900)
+    device = {}
+    for line in out.stdout.strip().splitlines():
+        try:
+            device = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    return {"config": 2, "metric": "ec_encode_jax_1gb",
+            "device_gbps": device.get("value"),
+            "e2e_wall_s": round(dt, 2),
+            "e2e_gbps": round(e2e_gbps, 3),
+            "note": "e2e includes disk + axon tunnel h2d (~0.5GB/s cap)"}
+
+
+def config3() -> dict:
+    from seaweedfs_tpu.ec import encoder
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        _make_dat(base + ".dat")
+        encoder.write_ec_files(base, backend="native")
+        # drop 2 shards (one data, one parity) and rebuild
+        for sid in (3, 11):
+            os.remove(encoder.shard_file_name(base, sid))
+        t0 = time.perf_counter()
+        rebuilt = encoder.rebuild_ec_files(base, backend="native")
+        dt = time.perf_counter() - t0
+        assert sorted(rebuilt) == [3, 11]
+        shard_bytes = os.path.getsize(encoder.shard_file_name(base, 0))
+    return {"config": 3, "metric": "ec_rebuild_2shards_cpu_native",
+            "wall_s": round(dt, 2),
+            "value": round(2 * shard_bytes / GB / dt, 3),
+            "unit": "GB/s rebuilt"}
+
+
+def config4() -> dict:
+    # virtual 8-device CPU mesh: shard the lane dimension, validate the
+    # sharded program and report its (CPU-bound) rate for the record
+    from seaweedfs_tpu.util import cpu_mesh
+    cpu_mesh.force_cpu_platform(8)
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from seaweedfs_tpu.ops import rs_kernel
+    from seaweedfs_tpu.ops.rs_code import coding_matrix, DATA_SHARDS
+    devs = np.array(jax.devices("cpu")[:8])
+    mesh = Mesh(devs, ("shard",))
+    m2 = rs_kernel.m2_bits(np.asarray(coding_matrix())[DATA_SHARDS:])
+    lanes = 8 << 20
+    data = np.random.default_rng(0).integers(
+        0, 256, (DATA_SHARDS, lanes), dtype=np.uint8)
+    sharding = NamedSharding(mesh, P(None, "shard"))
+    x = jax.device_put(data, sharding)
+
+    @jax.jit
+    def enc(d):
+        return rs_kernel.gf_linear(m2, d)
+
+    enc(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = enc(x)
+        out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    # correctness vs numpy
+    from seaweedfs_tpu.ops.rs_code import ReedSolomon
+    ref = ReedSolomon(backend="numpy").encode(data)
+    assert np.array_equal(np.asarray(out), ref)
+    return {"config": 4, "metric": "ec_encode_8way_cpu_mesh",
+            "devices": 8, "value": round(
+                DATA_SHARDS * lanes / GB / dt, 3),
+            "unit": "GB/s (virtual CPU mesh; shape/collective check, "
+                    "not TPU perf)"}
+
+
+def config5() -> dict:
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.ec import store_ec
+
+    def run_case(throttle_mbps):
+        with tempfile.TemporaryDirectory() as d:
+            store = Store([d])
+            store.add_volume(1)
+            v = store.find_volume(1)
+            blob = os.urandom(64 << 10)
+            for i in range(1, 1501):
+                v.write_needle(Needle(id=i, cookie=7, data=blob))
+            lat = []
+            stop = threading.Event()
+
+            def reader():
+                i = 1
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    v.read_needle(Needle(id=(i % 1500) + 1, cookie=7))
+                    lat.append(time.perf_counter() - t0)
+                    i += 1
+                    time.sleep(0.002)
+
+            th = threading.Thread(target=reader, daemon=True)
+            th.start()
+            if throttle_mbps is not None:
+                from seaweedfs_tpu.util.throttler import Throttler
+                throttler = Throttler(throttle_mbps)
+                # encode with throttled chunk pacing: emulate the
+                # server path's -compactionMBps on shard generation
+                from seaweedfs_tpu.ec import encoder as enc_mod
+                orig = enc_mod._read_padded
+
+                def slow_read(f, offset, length):
+                    throttler.maybe_slowdown(length)
+                    return orig(f, offset, length)
+                enc_mod._read_padded = slow_read
+                try:
+                    v.read_only = True
+                    store_ec.generate_ec_shards(store, 1, backend="native")
+                finally:
+                    enc_mod._read_padded = orig
+            elif throttle_mbps is None:
+                pass  # idle baseline: no encode at all
+            time.sleep(0.3)
+            stop.set()
+            th.join(timeout=5)
+            store.close()
+            lat.sort()
+            return lat[int(len(lat) * 0.99)] * 1000 if lat else 0.0
+
+    idle = run_case(None)
+    unthrottled = run_case(0)       # 0 = throttler disabled
+    throttled = run_case(200)       # 200 MB/s cap
+    return {"config": 5, "metric": "read_p99_during_ec_encode_ms",
+            "idle_p99_ms": round(idle, 2),
+            "encode_unthrottled_p99_ms": round(unthrottled, 2),
+            "encode_throttled_200mbps_p99_ms": round(throttled, 2)}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    configs = {"1": config1, "2": config2, "3": config3, "4": config4,
+               "5": config5}
+    todo = configs.values() if which == "all" else [configs[which]]
+    for fn in todo:
+        print(json.dumps(fn()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

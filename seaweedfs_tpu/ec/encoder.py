@@ -42,16 +42,25 @@ def _rs(backend: str) -> ReedSolomon:
 
 # --- encode -----------------------------------------------------------------
 
+def default_chunk_for(backend: str) -> int:
+    """Per-backend RS dispatch granularity: the jax path needs large
+    batches to amortize dispatch/tunnel latency; host backends prefer
+    cache-sized chunks."""
+    return DEFAULT_CHUNK_JAX if backend == "jax" else DEFAULT_CHUNK
+
+
 def write_ec_files(base_name: str, backend: str = "auto",
                    large_block: int = LARGE_BLOCK_SIZE,
                    small_block: int = SMALL_BLOCK_SIZE,
-                   chunk: int = DEFAULT_CHUNK) -> None:
+                   chunk: Optional[int] = None) -> None:
     """Generate .ec00-.ec13 from <base>.dat.
 
     Rows are consumed exactly like the reference encoder
     (ec_encoder.go:194-231): large rows while MORE than 10*large_block
     remains, then zero-padded small rows.
     """
+    if chunk is None:
+        chunk = default_chunk_for(backend)
     rs = _rs(backend)
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)

@@ -126,17 +126,15 @@ class FilerStoreWrapper(FilerStore):
         meta.ParseFromString(blob)
         return meta
 
-    def _write_hardlink(self, directory, entry) -> None:
+    def _write_hardlink(self, directory, entry, old) -> None:
         """Store shared meta in KV, a stub in the directory
-        (filerstore_hardlink.go maybeUpdateHardLink)."""
+        (filerstore_hardlink.go maybeUpdateHardLink). `old` is the
+        pre-fetched previous directory entry (or None) — a name newly
+        pointed at this link id counts as a new reference."""
         meta = self._read_hl_meta(entry.hard_link_id)
         counter = meta.hard_link_counter if meta is not None else 0
-        try:
-            existing = self.store.find_entry(directory, entry.name)
-            is_new_link = bytes(existing.hard_link_id) != \
-                bytes(entry.hard_link_id)
-        except NotFound:
-            is_new_link = True
+        is_new_link = old is None or \
+            bytes(old.hard_link_id) != bytes(entry.hard_link_id)
         full = filer_pb2.Entry()
         full.CopyFrom(entry)
         full.hard_link_counter = counter + 1 if is_new_link else \
@@ -191,7 +189,7 @@ class FilerStoreWrapper(FilerStore):
                 bytes(old.hard_link_id) != bytes(entry.hard_link_id):
             self.release_hardlink(old.hard_link_id)
         if entry.hard_link_id:
-            self._write_hardlink(directory, entry)
+            self._write_hardlink(directory, entry, old)
         else:
             self.store.insert_entry(directory, entry)
 
@@ -205,19 +203,9 @@ class FilerStoreWrapper(FilerStore):
                 bytes(old.hard_link_id) != bytes(entry.hard_link_id):
             self.release_hardlink(old.hard_link_id)
         if entry.hard_link_id:
-            meta = self._read_hl_meta(entry.hard_link_id)
-            full = filer_pb2.Entry()
-            full.CopyFrom(entry)
-            full.hard_link_counter = meta.hard_link_counter \
-                if meta is not None else 1
-            self.store.kv_put(self._hl_key(entry.hard_link_id),
-                              full.SerializeToString())
-            # the directory record must become a stub too, or this path
-            # keeps serving (and later deleting) its pre-link content
-            stub = filer_pb2.Entry(name=entry.name,
-                                   is_directory=entry.is_directory,
-                                   hard_link_id=bytes(entry.hard_link_id))
-            self.store.insert_entry(directory, stub)
+            # same path as insert: counts a newly-pointed name as a
+            # reference and replaces the directory record with a stub
+            self._write_hardlink(directory, entry, old)
         else:
             self.store.update_entry(directory, entry)
 

@@ -268,3 +268,28 @@ def test_hardlink_stub_overwrite_releases_old_link():
     assert f.store.hardlink_counter(link_id) == 1
     f.delete_entry("/e/g")  # last real link
     assert "3,ab1" in deleted
+
+
+def test_hardlink_update_entry_counts_new_reference():
+    """Re-pointing an existing plain entry at a link via update_entry
+    increments the counter, so the first unlink of the pair cannot free
+    the shared chunks (review regression)."""
+    from seaweedfs_tpu.filer import Filer
+
+    deleted = []
+    f = Filer(MemoryStore())
+    f.on_delete_chunks = lambda chunks: deleted.extend(
+        c.file_id for c in chunks)
+    link_id = b"\x45\x45"
+    f.create_entry("/e", _hl_entry("g", link_id))
+    plain = filer_pb2.Entry(name="f")
+    plain.chunks.add(file_id="3,ab1", size=7)
+    f.create_entry("/d", plain)
+    # convert /d/f into a second link of the same inode
+    f.update_entry("/d", _hl_entry("f", link_id))
+    assert f.store.hardlink_counter(link_id) == 2
+    f.delete_entry("/e/g")
+    assert deleted == []  # /d/f still references the chunks
+    assert f.find_entry("/d/f").attributes.file_size == 7
+    f.delete_entry("/d/f")
+    assert "3,ab1" in deleted
