@@ -76,9 +76,12 @@ def config2() -> dict:
         e2e_gbps = DAT_SIZE / GB / dt
     # sustained on-device rate: reuse bench.py (prints its own line)
     import subprocess
-    out = subprocess.run([sys.executable, "bench.py"], cwd=os.path.dirname(
-        os.path.abspath(__file__)), capture_output=True, text=True,
-        timeout=900)
+    try:
+        out = subprocess.run([sys.executable, "bench.py"],
+                             cwd=os.path.dirname(os.path.abspath(__file__)),
+                             capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError("bench.py timed out after 900s") from e
     device = {}
     for line in out.stdout.strip().splitlines():
         try:
@@ -86,6 +89,10 @@ def config2() -> dict:
             break
         except json.JSONDecodeError:
             continue
+    if out.returncode != 0 or "value" not in device:
+        raise RuntimeError(
+            f"bench.py failed (rc={out.returncode}): "
+            f"{out.stderr.strip()[-400:]}")
     return {"config": 2, "metric": "ec_encode_jax_1gb",
             "device_gbps": device.get("value"),
             "e2e_wall_s": round(dt, 2),
@@ -197,8 +204,6 @@ def config5() -> dict:
                     store_ec.generate_ec_shards(store, 1, backend="native")
                 finally:
                     enc_mod._read_padded = orig
-            elif throttle_mbps is None:
-                pass  # idle baseline: no encode at all
             time.sleep(0.3)
             stop.set()
             th.join(timeout=5)
@@ -219,9 +224,23 @@ def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     configs = {"1": config1, "2": config2, "3": config3, "4": config4,
                "5": config5}
-    todo = configs.values() if which == "all" else [configs[which]]
-    for fn in todo:
-        print(json.dumps(fn()), flush=True)
+    if which == "all":
+        # each config in its own subprocess: config2 initializes the
+        # TPU backend in-process, which would make config4's
+        # force_cpu_platform impossible in the same interpreter
+        import subprocess
+        for n in configs:
+            r = subprocess.run([sys.executable, __file__, n],
+                               capture_output=True, text=True,
+                               timeout=1800)
+            out = r.stdout.strip()
+            if r.returncode != 0 or not out:
+                print(json.dumps({"config": int(n), "error":
+                                  r.stderr.strip()[-300:]}), flush=True)
+            else:
+                print(out.splitlines()[-1], flush=True)
+        return
+    print(json.dumps(configs[which]()), flush=True)
 
 
 if __name__ == "__main__":

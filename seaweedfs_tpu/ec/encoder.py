@@ -207,7 +207,7 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:
 # --- rebuild ----------------------------------------------------------------
 
 def rebuild_ec_files(base_name: str, backend: str = "auto",
-                     chunk: int = DEFAULT_CHUNK,
+                     chunk: Optional[int] = None,
                      wanted: Optional[List[int]] = None) -> List[int]:
     """Regenerate missing .ecNN from >=10 present ones.
 
@@ -215,6 +215,8 @@ def rebuild_ec_files(base_name: str, backend: str = "auto",
     only needs the data shards). Returns the generated shard ids
     (reference generateMissingEcFiles, ec_encoder.go:88-118).
     """
+    if chunk is None:
+        chunk = default_chunk_for(backend)
     rs = _rs(backend)
     present = [i for i in range(TOTAL_SHARDS)
                if os.path.exists(shard_file_name(base_name, i))]

@@ -1,0 +1,6 @@
+"""Image post-processing on the volume read path
+(reference weed/images/resizing.go + orientation.go, hooked at
+server/volume_server_handlers_read.go:219-243)."""
+
+from seaweedfs_tpu.images.resizing import resized  # noqa: F401
+from seaweedfs_tpu.images.orientation import fix_orientation  # noqa: F401

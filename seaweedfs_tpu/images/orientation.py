@@ -1,0 +1,49 @@
+"""EXIF orientation normalization (reference weed/images/orientation.go).
+
+JPEGs carrying an EXIF Orientation tag are rewritten upright before
+serving/resizing, so downstream consumers never see rotated pixels.
+Anything undecodable passes through untouched.
+"""
+
+from __future__ import annotations
+
+import io
+
+# EXIF orientation -> (rotate degrees CCW, mirror horizontally first)
+_ORIENT = {
+    2: (0, True),
+    3: (180, False),
+    4: (180, True),
+    5: (270, True),
+    6: (270, False),
+    7: (90, True),
+    8: (90, False),
+}
+
+
+def fix_orientation(data: bytes, mime: str = "image/jpeg") -> bytes:
+    if mime != "image/jpeg":
+        return data
+    try:
+        from PIL import Image
+    except ImportError:
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        exif = img.getexif()
+        orientation = exif.get(274, 1)  # 274 = Orientation tag
+        if orientation not in _ORIENT:
+            return data
+        degrees, mirror = _ORIENT[orientation]
+        out = img
+        if mirror:
+            from PIL import ImageOps
+            out = ImageOps.mirror(out)
+        if degrees:
+            out = out.rotate(degrees, expand=True)
+        exif[274] = 1  # now upright
+        buf = io.BytesIO()
+        out.save(buf, format="JPEG", exif=exif.tobytes())
+        return buf.getvalue()
+    except Exception:
+        return data

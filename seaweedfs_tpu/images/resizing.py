@@ -1,0 +1,60 @@
+"""On-the-fly image resize (reference weed/images/resizing.go:17-52).
+
+Same contract as the reference handler: ``width``/``height`` query
+params with ``mode`` in {"" (fit within, preserving aspect), "fit"
+(letterbox to exact WxH), "fill" (cover + center-crop to exact WxH)}.
+Unsupported/undecodable content falls through untouched, exactly like
+the reference returns the original bytes on decode failure.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Tuple
+
+_FORMATS = {"image/jpeg": "JPEG", "image/png": "PNG", "image/gif": "GIF",
+            "image/webp": "WEBP"}
+
+
+def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
+            mode: str = "") -> Tuple[bytes, int, int]:
+    """Return (bytes, w, h); original data when no resize applies."""
+    if (width <= 0 and height <= 0) or mime not in _FORMATS:
+        return data, 0, 0
+    try:
+        from PIL import Image
+    except ImportError:  # image support not in this deployment
+        return data, 0, 0
+    try:
+        img = Image.open(io.BytesIO(data))
+        img.load()
+    except Exception:
+        return data, 0, 0
+    ow, oh = img.size
+    w, h = width or ow, height or oh
+    if mode == "fit":
+        # letterbox: scale to fit inside, pad to exact WxH
+        scaled = img.copy()
+        scaled.thumbnail((w, h))
+        canvas = Image.new(img.mode, (w, h))
+        canvas.paste(scaled, ((w - scaled.width) // 2,
+                              (h - scaled.height) // 2))
+        out = canvas
+    elif mode == "fill":
+        # cover: scale so both dims reach the target, center-crop
+        scale = max(w / ow, h / oh)
+        scaled = img.resize((max(1, round(ow * scale)),
+                             max(1, round(oh * scale))))
+        left = (scaled.width - w) // 2
+        top = (scaled.height - h) // 2
+        out = scaled.crop((left, top, left + w, top + h))
+    else:
+        # default: fit within the box preserving aspect ratio
+        out = img.copy()
+        out.thumbnail((w, h))
+    buf = io.BytesIO()
+    fmt = _FORMATS[mime]
+    if fmt == "JPEG" and out.mode not in ("RGB", "L"):
+        out = out.convert("RGB")
+    out.save(buf, format=fmt)
+    return buf.getvalue(), out.width, out.height

@@ -1,0 +1,6 @@
+"""Query engine: S3-Select-style filter/projection over stored JSON
+(reference weed/query/json/query_json.go + server/volume_grpc_query.go)."""
+
+from seaweedfs_tpu.query.json_query import (  # noqa: F401
+    Query, filter_json, get_path, query_json_line, query_json_lines,
+)

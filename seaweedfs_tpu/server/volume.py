@@ -353,6 +353,36 @@ class VolumeServer:
                     file_id=fid, status=404, error=str(e)))
         return volume_server_pb2.BatchDeleteResponse(results=results)
 
+    # -- gRPC: query (S3 Select-ish) -------------------------------------------
+
+    def Query(self, request, context):
+        """Scan stored JSON documents: filter + project, one stripe per
+        file id (reference server/volume_grpc_query.go:12-76)."""
+        import json as _json
+        from seaweedfs_tpu.query import Query as JQuery, query_json_lines
+        q = JQuery(field=request.filter.field,
+                   op=request.filter.operand,
+                   value=request.filter.value)
+        for fid in request.from_file_ids:
+            try:
+                f = parse_fid(fid)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            n = Needle(id=f.key, cookie=f.cookie)
+            try:
+                got = self._read_needle(f.volume_id, n)
+            except (NeedleError, EcShardNotFound, CookieMismatch) as e:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"{fid}: {e}")
+            data = got.data
+            if got.is_compressed:
+                data = gzip.decompress(data)
+            records = b"".join(
+                _json.dumps(rec).encode() + b"\n"
+                for rec in query_json_lines(
+                    data, list(request.selections), q))
+            yield volume_server_pb2.QueriedStripe(records=records)
+
     # -- gRPC: replica copy ----------------------------------------------------
 
     def CopyFile(self, request, context):
@@ -895,7 +925,7 @@ def _make_http_handler(vs: VolumeServer):
             except (NeedleError, EcShardNotFound) as e:
                 self._json({"error": str(e)}, code=404)
                 return
-            self._send_needle(got)
+            self._send_needle(got, params)
 
         do_HEAD = do_GET
 
@@ -925,7 +955,8 @@ def _make_http_handler(vs: VolumeServer):
             self._json({"error": f"volume {f.volume_id} not found"},
                        code=404)
 
-        def _send_needle(self, got: Needle) -> None:
+        def _send_needle(self, got: Needle,
+                         params: Optional[dict] = None) -> None:
             etag = f'"{got.etag}"'
             if self.headers.get("If-None-Match") == etag:
                 self._reply(304)
@@ -935,13 +966,31 @@ def _make_http_handler(vs: VolumeServer):
             if got.name:
                 headers["Content-Disposition"] = \
                     f'inline; filename="{got.name.decode("utf-8", "replace")}"'
-            if got.mime:
-                headers["Content-Type"] = got.mime.decode("utf-8", "replace")
+            mime = got.mime.decode("utf-8", "replace") if got.mime else ""
+            if mime:
+                headers["Content-Type"] = mime
+            params = params or {}
+            want_resize = mime.startswith("image/") and \
+                ("width" in params or "height" in params)
             if got.is_compressed:
-                if "gzip" in (self.headers.get("Accept-Encoding") or ""):
+                if not want_resize and "gzip" in (
+                        self.headers.get("Accept-Encoding") or ""):
                     headers["Content-Encoding"] = "gzip"
                 else:
                     data = gzip.decompress(data)
+            if want_resize:
+                # EXIF-upright then resize, like the reference read
+                # handler (volume_server_handlers_read.go:219-243)
+                from seaweedfs_tpu.images import fix_orientation, resized
+                data = fix_orientation(data, mime)
+                try:
+                    width = int(params.get("width", ["0"])[0] or 0)
+                    height = int(params.get("height", ["0"])[0] or 0)
+                except ValueError:
+                    width = height = 0
+                data, _, _ = resized(
+                    data, mime, width=width, height=height,
+                    mode=params.get("mode", [""])[0])
             rng = self.headers.get("Range")
             if rng and rng.startswith("bytes=") and not got.is_compressed:
                 try:

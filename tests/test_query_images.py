@@ -1,0 +1,160 @@
+"""Query engine + image resize/orientation tests (VERDICT missing #9).
+
+Reference: weed/query/json/query_json.go:17 (filter/project),
+server/volume_grpc_query.go:12 (Query RPC), weed/images/resizing.go +
+orientation.go hooked at volume_server_handlers_read.go:219-243.
+"""
+
+import io
+import json
+
+import pytest
+
+from seaweedfs_tpu.images import fix_orientation, resized
+from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+from seaweedfs_tpu.query import Query, filter_json, get_path, \
+    query_json_line, query_json_lines
+from seaweedfs_tpu.query.json_query import _MISSING
+
+from tests.cluster_util import Cluster
+
+
+# -- json query (pure) --------------------------------------------------------
+
+
+def test_get_path_dotted_and_arrays():
+    doc = {"a": {"b": 2}, "items": [{"name": "x"}, {"name": "y"}]}
+    assert get_path(doc, "a.b") == 2
+    assert get_path(doc, "items.1.name") == "y"
+    assert get_path(doc, "a.missing") is _MISSING
+    assert get_path(doc, "items.9.name") is _MISSING
+
+
+def test_filter_operands():
+    doc = {"age": 30, "name": "alice", "tags": ["x"]}
+    assert filter_json(doc, Query("age", "=", "30"))
+    assert filter_json(doc, Query("age", ">", "29"))
+    assert filter_json(doc, Query("age", "<=", "30"))
+    assert not filter_json(doc, Query("age", "<", "30"))
+    assert filter_json(doc, Query("name", "=", "alice"))
+    assert filter_json(doc, Query("name", "!=", "bob"))
+    assert filter_json(doc, Query("name", "%", "ali*"))
+    assert filter_json(doc, Query("tags"))        # existence
+    assert not filter_json(doc, Query("absent"))  # missing field
+    with pytest.raises(ValueError):
+        filter_json(doc, Query("age", "~", "1"))
+
+
+def test_query_json_line_projection():
+    line = json.dumps({"user": {"id": 7, "name": "n"}, "score": 9})
+    ok, rec = query_json_line(line, ["user.id", "score"],
+                              Query("score", ">=", "5"))
+    assert ok and rec == {"user.id": 7, "score": 9}
+    ok, rec = query_json_line(line, [], Query("score", "<", "5"))
+    assert not ok
+    ok, rec = query_json_line("not json", [], Query("x"))
+    assert not ok
+
+
+def test_query_json_lines_stream():
+    data = b"\n".join(json.dumps({"k": i}).encode() for i in range(10))
+    got = list(query_json_lines(data, ["k"], Query("k", ">", "6")))
+    assert got == [{"k": 7}, {"k": 8}, {"k": 9}]
+
+
+# -- images (pure) ------------------------------------------------------------
+
+
+def _jpeg(w=64, h=32, orientation=None) -> bytes:
+    from PIL import Image
+    img = Image.new("RGB", (w, h), (200, 10, 10))
+    buf = io.BytesIO()
+    if orientation:
+        exif = Image.Exif()
+        exif[274] = orientation
+        img.save(buf, format="JPEG", exif=exif.tobytes())
+    else:
+        img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _dims(data: bytes):
+    from PIL import Image
+    return Image.open(io.BytesIO(data)).size
+
+
+def test_resize_default_fit_within():
+    out, w, h = resized(_jpeg(64, 32), "image/jpeg", width=32)
+    assert (w, h) == (32, 16)
+    assert _dims(out) == (32, 16)
+
+
+def test_resize_modes():
+    out, w, h = resized(_jpeg(64, 32), "image/jpeg", width=20, height=20,
+                        mode="fit")
+    assert (w, h) == (20, 20) and _dims(out) == (20, 20)
+    out, w, h = resized(_jpeg(64, 32), "image/jpeg", width=20, height=20,
+                        mode="fill")
+    assert (w, h) == (20, 20) and _dims(out) == (20, 20)
+
+
+def test_resize_passthrough_for_non_images():
+    data = b"not an image"
+    out, w, h = resized(data, "text/plain", width=10)
+    assert out == data
+    out, w, h = resized(b"\xff\xd8broken", "image/jpeg", width=10)
+    assert out == b"\xff\xd8broken"
+
+
+def test_exif_orientation_fixed():
+    # orientation 6 = rotate 270 CCW to upright: 64x32 -> 32x64
+    rotated = _jpeg(64, 32, orientation=6)
+    fixed = fix_orientation(rotated, "image/jpeg")
+    assert _dims(fixed) == (32, 64)
+    from PIL import Image
+    assert Image.open(io.BytesIO(fixed)).getexif().get(274, 1) == 1
+    # non-jpeg and broken data pass through
+    assert fix_orientation(b"x", "image/png") == b"x"
+    assert fix_orientation(b"x", "image/jpeg") == b"x"
+
+
+# -- through the servers ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("query_images"),
+                n_volume_servers=1)
+    yield c
+    c.stop()
+
+
+def test_query_rpc_scans_json(cluster):
+    docs = b"\n".join(json.dumps(
+        {"name": f"u{i}", "age": 20 + i}).encode() for i in range(10))
+    fid = cluster.upload(docs, mime="application/json")
+    url = cluster.wait_for(
+        lambda: cluster.master.topo.lookup(int(fid.split(",")[0])),
+        what="vid location")[0].url
+    stripes = list(volume_stub(url).Query(volume_server_pb2.QueryRequest(
+        from_file_ids=[fid],
+        filter=volume_server_pb2.QueryRequest.Filter(
+            field="age", operand=">=", value="27"),
+        selections=["name"])))
+    assert len(stripes) == 1
+    recs = [json.loads(l) for l in stripes[0].records.splitlines()]
+    assert recs == [{"name": "u7"}, {"name": "u8"}, {"name": "u9"}]
+
+
+def test_image_resize_on_read_path(cluster):
+    fid = cluster.upload(_jpeg(64, 32), mime="image/jpeg")
+    with cluster.fetch(fid) as r:
+        full = r.read()
+    assert _dims(full) == (64, 32)
+    # width param triggers the resize hook
+    import urllib.request
+    lk = cluster.master.topo.lookup(int(fid.split(",")[0]))[0].url
+    with urllib.request.urlopen(
+            f"http://{lk}/{fid}?width=16", timeout=10) as r:
+        small = r.read()
+    assert _dims(small) == (16, 8)
