@@ -230,9 +230,15 @@ def main():
         # force_cpu_platform impossible in the same interpreter
         import subprocess
         for n in configs:
-            r = subprocess.run([sys.executable, __file__, n],
-                               capture_output=True, text=True,
-                               timeout=1800)
+            try:
+                r = subprocess.run([sys.executable, __file__, n],
+                                   capture_output=True, text=True,
+                                   timeout=1800)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"config": int(n),
+                                  "error": "timed out after 1800s"}),
+                      flush=True)
+                continue
             out = r.stdout.strip()
             if r.returncode != 0 or not out:
                 print(json.dumps({"config": int(n), "error":

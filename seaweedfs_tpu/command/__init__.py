@@ -76,3 +76,14 @@ def main(argv=None) -> int:
 from seaweedfs_tpu.command import servers  # noqa: E402,F401
 from seaweedfs_tpu.command import tools  # noqa: E402,F401
 from seaweedfs_tpu.command import benchmark  # noqa: E402,F401
+
+
+def setup_client_tls(role: str = "client") -> None:
+    """Enable mutual TLS from security.toml [grpc.*] for this process
+    (shared by server subcommands and the client tools — a secured
+    cluster must be dialable by `shell`/`upload`/... too)."""
+    from seaweedfs_tpu.security import tls as tls_mod
+    from seaweedfs_tpu.util import config as config_mod
+    conf = config_mod.load_configuration("security")
+    if conf:
+        tls_mod.configure_process_tls(conf, role)

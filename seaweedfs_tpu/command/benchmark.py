@@ -163,6 +163,8 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
 
 @command("benchmark", "write/read load generator with latency stats")
 def run_bench(args) -> int:
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls()
     p = argparse.ArgumentParser(prog="benchmark")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-c", dest="concurrency", type=int, default=16)

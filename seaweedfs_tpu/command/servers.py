@@ -22,6 +22,13 @@ from seaweedfs_tpu.util import grace, wlog
 log = wlog.logger("command")
 
 
+def _setup_tls(role: str) -> None:
+    """Enable mutual TLS when security.toml carries [grpc.*] sections
+    (reference security/tls.go; plaintext without them)."""
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls(role)
+
+
 def _serve_forever(stoppables: List) -> int:
     done = threading.Event()
     for s in stoppables:
@@ -91,6 +98,7 @@ def _build_master(opts):
 
 @command("master", "start a master server (control plane)")
 def run_master(args) -> int:
+    _setup_tls("master")
     opts = _master_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
     m = _build_master(opts)
@@ -155,6 +163,7 @@ def _build_volume(opts):
 
 @command("volume", "start a volume server (data plane)")
 def run_volume(args) -> int:
+    _setup_tls("volume")
     opts = _volume_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
     vs = _build_volume(opts)
@@ -195,6 +204,7 @@ def _build_filer(opts):
 
 @command("filer", "start a filer (namespace server)")
 def run_filer(args) -> int:
+    _setup_tls("filer")
     opts = _filer_parser().parse_args(args)
     fs = _build_filer(opts)
     fs.start()

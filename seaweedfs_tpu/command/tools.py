@@ -25,6 +25,8 @@ def run_version(args) -> int:
 
 @command("shell", "interactive admin shell against a master")
 def run_shell(args) -> int:
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls()
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("command", nargs="*",
@@ -47,6 +49,8 @@ def run_shell(args) -> int:
 
 @command("upload", "upload files via master assignment")
 def run_upload(args) -> int:
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls()
     p = argparse.ArgumentParser(prog="upload")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-collection", default="")
@@ -71,6 +75,8 @@ def run_upload(args) -> int:
 
 @command("download", "download a file id to disk")
 def run_download(args) -> int:
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls()
     p = argparse.ArgumentParser(prog="download")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-dir", default=".")
@@ -88,6 +94,8 @@ def run_download(args) -> int:
 
 @command("delete", "delete file ids")
 def run_delete(args) -> int:
+    from seaweedfs_tpu.command import setup_client_tls
+    setup_client_tls()
     p = argparse.ArgumentParser(prog="delete")
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("fids", nargs="+")
@@ -168,7 +176,25 @@ type = "memory"  # or "snowflake"
 #region = "us-east-1"
 """,
     "security": """\
-# security.toml (reference command/scaffold.go [jwt.signing])
+# security.toml (reference command/scaffold.go [jwt.signing] + [grpc.*])
+
+# mutual TLS for all gRPC (reference security/tls.go). All three paths
+# must be set per role to enable; absent = plaintext.
+#[grpc]
+#ca = "/etc/seaweedfs/ca.crt"
+#[grpc.master]
+#cert = "/etc/seaweedfs/master.crt"
+#key = "/etc/seaweedfs/master.key"
+#[grpc.volume]
+#cert = "/etc/seaweedfs/volume.crt"
+#key = "/etc/seaweedfs/volume.key"
+#[grpc.filer]
+#cert = "/etc/seaweedfs/filer.crt"
+#key = "/etc/seaweedfs/filer.key"
+#[grpc.client]
+#cert = "/etc/seaweedfs/client.crt"
+#key = "/etc/seaweedfs/client.key"
+
 [jwt.signing]
 key = ""             # base64 secret; empty disables write JWT
 expires_after_seconds = 10

@@ -9,41 +9,25 @@ from __future__ import annotations
 
 import io
 
-# EXIF orientation -> (rotate degrees CCW, mirror horizontally first)
-_ORIENT = {
-    2: (0, True),
-    3: (180, False),
-    4: (180, True),
-    5: (270, True),
-    6: (270, False),
-    7: (90, True),
-    8: (90, False),
-}
-
 
 def fix_orientation(data: bytes, mime: str = "image/jpeg") -> bytes:
     if mime != "image/jpeg":
         return data
     try:
-        from PIL import Image
+        from PIL import Image, ImageOps
     except ImportError:
         return data
     try:
         img = Image.open(io.BytesIO(data))
-        exif = img.getexif()
-        orientation = exif.get(274, 1)  # 274 = Orientation tag
-        if orientation not in _ORIENT:
+        orientation = img.getexif().get(274, 1)  # 274 = Orientation
+        if orientation in (0, 1):
             return data
-        degrees, mirror = _ORIENT[orientation]
-        out = img
-        if mirror:
-            from PIL import ImageOps
-            out = ImageOps.mirror(out)
-        if degrees:
-            out = out.rotate(degrees, expand=True)
-        exif[274] = 1  # now upright
+        # exif_transpose implements the full 8-state orientation table
+        # (incl. the transpose/transverse cases 5 and 7) and clears the
+        # tag on the result
+        out = ImageOps.exif_transpose(img)
         buf = io.BytesIO()
-        out.save(buf, format="JPEG", exif=exif.tobytes())
+        out.save(buf, format="JPEG", exif=out.getexif().tobytes())
         return buf.getvalue()
     except Exception:
         return data

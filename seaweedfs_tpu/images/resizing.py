@@ -56,5 +56,19 @@ def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
     fmt = _FORMATS[mime]
     if fmt == "JPEG" and out.mode not in ("RGB", "L"):
         out = out.convert("RGB")
+    if fmt == "GIF" and getattr(img, "n_frames", 1) > 1:
+        # animated GIF: resize every frame, keep the animation (the
+        # reference resizes frame-by-frame too, resizing.go)
+        from PIL import ImageSequence
+        frames = []
+        for frame in ImageSequence.Iterator(img):
+            f = frame.copy()
+            f.thumbnail((w, h))
+            frames.append(f)
+        frames[0].save(buf, format="GIF", save_all=True,
+                       append_images=frames[1:],
+                       duration=img.info.get("duration", 100),
+                       loop=img.info.get("loop", 0))
+        return buf.getvalue(), frames[0].width, frames[0].height
     out.save(buf, format=fmt)
     return buf.getvalue(), out.width, out.height

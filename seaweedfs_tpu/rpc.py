@@ -23,6 +23,25 @@ GRPC_PORT_OFFSET = 10000
 _channel_lock = threading.Lock()
 _channels: Dict[str, grpc.Channel] = {}
 
+# process-wide TLS (security/tls.py configure_process_tls). None =
+# plaintext, matching the reference's default when security.toml has no
+# [grpc.*] sections.
+_server_credentials: Optional[grpc.ServerCredentials] = None
+_channel_credentials: Optional[grpc.ChannelCredentials] = None
+
+
+def set_server_credentials(creds) -> None:
+    global _server_credentials
+    _server_credentials = creds
+
+
+def set_channel_credentials(creds) -> None:
+    """Future channels dial with mTLS; existing cached plaintext
+    channels are dropped so they re-dial secured."""
+    global _channel_credentials
+    _channel_credentials = creds
+    close_channels()
+
 
 def grpc_address(url: str) -> str:
     """Map an HTTP "host:port" to its gRPC sibling "host:port+10000"."""
@@ -38,10 +57,13 @@ def cached_channel(address: str) -> grpc.Channel:
     with _channel_lock:
         ch = _channels.get(address)
         if ch is None:
-            ch = grpc.insecure_channel(
-                address,
-                options=[("grpc.max_send_message_length", 64 << 20),
-                         ("grpc.max_receive_message_length", 64 << 20)])
+            options = [("grpc.max_send_message_length", 64 << 20),
+                       ("grpc.max_receive_message_length", 64 << 20)]
+            if _channel_credentials is not None:
+                ch = grpc.secure_channel(address, _channel_credentials,
+                                         options=options)
+            else:
+                ch = grpc.insecure_channel(address, options=options)
             _channels[address] = ch
         return ch
 
@@ -130,7 +152,10 @@ def make_server(address: str, handlers, max_workers: int = 16) -> grpc.Server:
                  ("grpc.so_reuseport", 0)])
     for h in handlers:
         server.add_generic_rpc_handlers((h,))
-    bound = server.add_insecure_port(address)
+    if _server_credentials is not None:
+        bound = server.add_secure_port(address, _server_credentials)
+    else:
+        bound = server.add_insecure_port(address)
     if bound == 0:
         raise OSError(f"cannot bind grpc server to {address}")
     server.bound_port = bound  # OS-assigned when address ends in :0

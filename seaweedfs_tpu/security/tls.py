@@ -1,0 +1,83 @@
+"""Mutual TLS for the gRPC plane (reference weed/security/tls.go:15-80).
+
+The reference reads ``security.toml`` ``[grpc.ca]`` + per-component
+``[grpc.<role>] cert/key`` sections and wraps every gRPC server and
+client channel in mutual TLS when they are set; with no config,
+everything stays plaintext. Same contract here: ``configure_from_config``
+reads the security Configuration and installs credential factories into
+seaweedfs_tpu.rpc; servers then listen with ssl_server_credentials
+(client certs REQUIRED — mutual) and cached channels dial with
+ssl_channel_credentials + the client cert pair.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import grpc
+
+from seaweedfs_tpu.util import wlog
+
+log = wlog.logger("security.tls")
+
+
+class TlsConfig:
+    """Loaded cert material for one process role."""
+
+    def __init__(self, ca_path: str = "", cert_path: str = "",
+                 key_path: str = ""):
+        self.ca_path = ca_path
+        self.cert_path = cert_path
+        self.key_path = key_path
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.ca_path and self.cert_path and self.key_path)
+
+    def _read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def server_credentials(self) -> Optional[grpc.ServerCredentials]:
+        if not self.enabled:
+            return None
+        return grpc.ssl_server_credentials(
+            [(self._read(self.key_path), self._read(self.cert_path))],
+            root_certificates=self._read(self.ca_path),
+            require_client_auth=True)  # mutual, like the reference
+
+    def channel_credentials(self) -> Optional[grpc.ChannelCredentials]:
+        if not self.enabled:
+            return None
+        return grpc.ssl_channel_credentials(
+            root_certificates=self._read(self.ca_path),
+            private_key=self._read(self.key_path),
+            certificate_chain=self._read(self.cert_path))
+
+
+def load_tls_config(security_conf, component: str) -> TlsConfig:
+    """[grpc.ca] + [grpc.<component>] cert/key, falling back to
+    [grpc.client] for dialing roles (reference tls.go LoadClientTLS /
+    LoadServerTLS)."""
+    if security_conf is None or not security_conf:
+        return TlsConfig()
+    ca = security_conf.get_string("grpc.ca")
+    cert = security_conf.get_string(f"grpc.{component}.cert")
+    key = security_conf.get_string(f"grpc.{component}.key")
+    return TlsConfig(ca_path=ca, cert_path=cert, key_path=key)
+
+
+def configure_process_tls(security_conf, server_role: str) -> None:
+    """Install TLS on the process's gRPC plumbing: the server listens
+    with the role's cert; every outgoing channel uses [grpc.client].
+    No-op when the sections are absent."""
+    from seaweedfs_tpu import rpc
+    server_tls = load_tls_config(security_conf, server_role)
+    client_tls = load_tls_config(security_conf, "client")
+    if server_tls.enabled:
+        rpc.set_server_credentials(server_tls.server_credentials())
+        log.info("grpc server TLS enabled (%s)", server_role)
+    if client_tls.enabled:
+        rpc.set_channel_credentials(client_tls.channel_credentials())
+        log.info("grpc client mTLS enabled")

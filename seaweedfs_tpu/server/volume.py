@@ -358,7 +358,6 @@ class VolumeServer:
     def Query(self, request, context):
         """Scan stored JSON documents: filter + project, one stripe per
         file id (reference server/volume_grpc_query.go:12-76)."""
-        import json as _json
         from seaweedfs_tpu.query import Query as JQuery, query_json_lines
         q = JQuery(field=request.filter.field,
                    op=request.filter.operand,
@@ -378,7 +377,7 @@ class VolumeServer:
             if got.is_compressed:
                 data = gzip.decompress(data)
             records = b"".join(
-                _json.dumps(rec).encode() + b"\n"
+                json.dumps(rec).encode() + b"\n"
                 for rec in query_json_lines(
                     data, list(request.selections), q))
             yield volume_server_pb2.QueriedStripe(records=records)

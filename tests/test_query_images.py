@@ -106,16 +106,51 @@ def test_resize_passthrough_for_non_images():
     assert out == b"\xff\xd8broken"
 
 
-def test_exif_orientation_fixed():
-    # orientation 6 = rotate 270 CCW to upright: 64x32 -> 32x64
-    rotated = _jpeg(64, 32, orientation=6)
-    fixed = fix_orientation(rotated, "image/jpeg")
-    assert _dims(fixed) == (32, 64)
+def test_exif_orientation_fixed_all_eight():
+    """Every EXIF orientation (2-8, incl. the transpose/transverse
+    cases 5 and 7) recovers the upright pixel layout."""
     from PIL import Image
-    assert Image.open(io.BytesIO(fixed)).getexif().get(274, 1) == 1
+    base = Image.new("RGB", (64, 32), (10, 10, 10))
+    for x in range(32):
+        for y in range(16):
+            base.putpixel((x, y), (250, 20, 20))  # red top-left quadrant
+    inv = {2: Image.FLIP_LEFT_RIGHT, 3: Image.ROTATE_180,
+           4: Image.FLIP_TOP_BOTTOM, 5: Image.TRANSPOSE,
+           6: Image.ROTATE_90, 7: Image.TRANSVERSE, 8: Image.ROTATE_270}
+    for orientation in (2, 3, 4, 5, 6, 7, 8):
+        stored = base.transpose(inv[orientation])
+        exif = Image.Exif()
+        exif[274] = orientation
+        buf = io.BytesIO()
+        stored.save(buf, format="JPEG", exif=exif.tobytes(), quality=95)
+        fixed = Image.open(io.BytesIO(
+            fix_orientation(buf.getvalue(), "image/jpeg")))
+        assert fixed.size == (64, 32), orientation
+        r, g, _ = fixed.getpixel((8, 8))
+        assert r > 180 and g < 90, (orientation, (r, g))
+        assert fixed.getexif().get(274, 1) == 1
     # non-jpeg and broken data pass through
     assert fix_orientation(b"x", "image/png") == b"x"
     assert fix_orientation(b"x", "image/jpeg") == b"x"
+
+
+def test_resize_animated_gif_keeps_frames():
+    from PIL import Image
+    # visually distinct frames (PIL optimizes identical frames away)
+    frames = []
+    for c in ((255, 0, 0), (0, 255, 0), (0, 0, 255)):
+        f = Image.new("RGB", (40, 20), (0, 0, 0))
+        for x in range(20):
+            f.putpixel((x, 5), c)
+        frames.append(f.convert("P"))
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True,
+                   append_images=frames[1:], duration=50, loop=0)
+    assert Image.open(io.BytesIO(buf.getvalue())).n_frames == 3
+    out, w, h = resized(buf.getvalue(), "image/gif", width=20)
+    img = Image.open(io.BytesIO(out))
+    assert img.size == (20, 10)
+    assert getattr(img, "n_frames", 1) == 3
 
 
 # -- through the servers ------------------------------------------------------

@@ -1,0 +1,92 @@
+"""Mutual TLS on the gRPC plane (VERDICT missing #10; reference
+weed/security/tls.go:15-80).
+
+Certs are generated with the system openssl; the test enables process
+TLS, runs a real master + volume server through secured channels, then
+proves a plaintext client cannot talk to the secured server — and
+restores the plaintext default for the rest of the suite.
+"""
+
+import subprocess
+
+import grpc
+import pytest
+
+from seaweedfs_tpu import rpc
+from seaweedfs_tpu.security import tls as tls_mod
+from seaweedfs_tpu.util.config import Configuration
+
+
+def _gen_certs(d) -> None:
+    """CA + server/client pairs signed for 127.0.0.1 (SAN)."""
+    san = d / "san.cnf"
+    san.write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", "ca.key", "-out", "ca.crt", "-days", "1",
+        "-subj", "/CN=test-ca")
+    for name in ("server", "client"):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", f"{name}.key", "-out", f"{name}.csr",
+            "-subj", f"/CN={name}")
+        run("openssl", "x509", "-req", "-in", f"{name}.csr",
+            "-CA", "ca.crt", "-CAkey", "ca.key", "-CAcreateserial",
+            "-out", f"{name}.crt", "-days", "1", "-extfile", str(san))
+
+
+@pytest.fixture
+def tls_env(tmp_path):
+    _gen_certs(tmp_path)
+    conf = Configuration({
+        "grpc": {
+            "ca": str(tmp_path / "ca.crt"),
+            "master": {"cert": str(tmp_path / "server.crt"),
+                       "key": str(tmp_path / "server.key")},
+            "volume": {"cert": str(tmp_path / "server.crt"),
+                       "key": str(tmp_path / "server.key")},
+            "client": {"cert": str(tmp_path / "client.crt"),
+                       "key": str(tmp_path / "client.key")},
+        }})
+    yield conf
+    # restore plaintext for the rest of the suite
+    rpc.set_server_credentials(None)
+    rpc.set_channel_credentials(None)
+
+
+def test_load_tls_config_gating(tls_env, tmp_path):
+    c = tls_mod.load_tls_config(tls_env, "master")
+    assert c.enabled
+    assert not tls_mod.load_tls_config(Configuration({}), "master").enabled
+    # partial config (no key) stays disabled
+    partial = Configuration({"grpc": {
+        "ca": str(tmp_path / "ca.crt"),
+        "master": {"cert": str(tmp_path / "server.crt")}}})
+    assert not tls_mod.load_tls_config(partial, "master").enabled
+
+
+def test_mutual_tls_cluster_roundtrip(tls_env, tmp_path):
+    from tests.cluster_util import Cluster
+
+    tls_mod.configure_process_tls(tls_env, "master")
+    c = Cluster(tmp_path / "cluster", n_volume_servers=1)
+    try:
+        # the whole control plane (heartbeats, assign lookups) already
+        # ran over mTLS or the cluster wouldn't have come up; prove a
+        # full data round-trip too
+        fid = c.upload(b"over-mtls")
+        with c.fetch(fid) as r:
+            assert r.read() == b"over-mtls"
+        # a PLAINTEXT channel cannot complete the handshake with the
+        # secured server
+        target = rpc.grpc_address(c.master.url)
+        insecure = grpc.insecure_channel(target)
+        with pytest.raises(grpc.FutureTimeoutError):
+            grpc.channel_ready_future(insecure).result(timeout=2)
+        insecure.close()
+    finally:
+        c.stop()
+        rpc.set_server_credentials(None)
+        rpc.set_channel_credentials(None)
