@@ -72,10 +72,6 @@ def main(argv=None) -> int:
         return 130
 
 
-# registration side effects
-from seaweedfs_tpu.command import servers  # noqa: E402,F401
-from seaweedfs_tpu.command import tools  # noqa: E402,F401
-from seaweedfs_tpu.command import benchmark  # noqa: E402,F401
 
 
 def setup_client_tls(role: str = "client") -> None:
@@ -87,3 +83,10 @@ def setup_client_tls(role: str = "client") -> None:
     conf = config_mod.load_configuration("security")
     if conf:
         tls_mod.configure_process_tls(conf, role)
+
+
+# registration side effects
+from seaweedfs_tpu.command import servers  # noqa: E402,F401
+from seaweedfs_tpu.command import tools  # noqa: E402,F401
+from seaweedfs_tpu.command import benchmark  # noqa: E402,F401
+from seaweedfs_tpu.command import async_services  # noqa: E402,F401

@@ -1,0 +1,130 @@
+"""Async-service subcommands: filer.replicate, filer.sync, msgBroker,
+mount.
+
+Reference: weed/command/filer_replication.go (consume filer events,
+apply to a configured sink), filer_sync.go:64+ (active-active),
+msg_broker.go, mount.go.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+from seaweedfs_tpu.command import command, setup_client_tls
+from seaweedfs_tpu.util import grace, wlog
+
+log = wlog.logger("command.async")
+
+
+@command("filer.replicate", "stream filer changes into a configured sink")
+def run_filer_replicate(args) -> int:
+    """Reads replication.toml: [source.filer] + the first enabled
+    [sink.*] section (reference replication scaffold / replicator.go)."""
+    setup_client_tls()
+    p = argparse.ArgumentParser(prog="filer.replicate")
+    p.add_argument("-config", default=None,
+                   help="replication.toml path (default: search path)")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.util import config as config_mod
+    if opts.config:
+        import os
+        search = [os.path.dirname(os.path.abspath(opts.config)) or "."]
+    else:
+        search = None
+    conf = config_mod.load_configuration("replication",
+                                         search_path=search)
+    if not conf:
+        print("no replication.toml found; run "
+              "`scaffold -config replication`", file=sys.stderr)
+        return 1
+    src_url = conf.get_string("source.filer.grpcAddress") or \
+        conf.get_string("source.filer.address")
+    directory = conf.get_string("source.filer.directory", "/")
+    sinks = conf.get("sink") or {}
+    enabled = [(k, v) for k, v in sinks.items()
+               if isinstance(v, dict) and v.get("enabled")]
+    if not src_url or not enabled:
+        print("replication.toml needs [source.filer] grpcAddress and "
+              "one enabled [sink.*]", file=sys.stderr)
+        return 1
+    kind, props = enabled[0]
+    props = {k: v for k, v in props.items() if k != "enabled"}
+    from seaweedfs_tpu.replication.sinks import make_sink
+    from seaweedfs_tpu.replication.source import FilerSource
+    from seaweedfs_tpu.replication.replicator import Replicator
+    from seaweedfs_tpu.replication.filer_sync import _OneWay
+
+    sink = make_sink(kind, **props)
+    # ride the same resilient tail loop filer.sync uses, with our sink
+    worker = _OneWay(src_url, src_url, directory,
+                     replicator=Replicator(FilerSource(src_url), sink,
+                                           path_filter=directory))
+    worker.start(since_ns=0)
+    log.info("replicating %s%s -> %s sink", src_url, directory, kind)
+    return _wait(worker)
+
+
+@command("filer.sync", "active-active sync between two filers")
+def run_filer_sync(args) -> int:
+    setup_client_tls()
+    p = argparse.ArgumentParser(prog="filer.sync")
+    p.add_argument("-a", required=True, help="filer A host:port")
+    p.add_argument("-b", required=True, help="filer B host:port")
+    p.add_argument("-a.path", dest="path_a", default="/")
+    p.add_argument("-b.path", dest="path_b", default="/")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.replication.filer_sync import FilerSync
+    sync = FilerSync(opts.a, opts.b, path_prefix=opts.path_a)
+    sync.start()
+    log.info("filer.sync %s <-> %s started", opts.a, opts.b)
+    return _wait(sync)
+
+
+@command("msgBroker", "start the pub/sub message broker")
+def run_msg_broker(args) -> int:
+    setup_client_tls()
+    p = argparse.ArgumentParser(prog="msgBroker")
+    p.add_argument("-port", type=int, default=17777)
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.messaging.broker import MessageBroker
+    broker = MessageBroker(filer_url=opts.filer, ip=opts.ip,
+                           port=opts.port)
+    broker.start()
+    log.info("message broker %s:%d started", opts.ip, opts.port)
+    return _wait(broker)
+
+
+@command("mount", "mount the filer as a filesystem (needs kernel FUSE)")
+def run_mount(args) -> int:
+    p = argparse.ArgumentParser(prog="mount")
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-dir", required=True, help="mount point")
+    p.add_argument("-filer.path", dest="filer_path", default="/")
+    opts = p.parse_args(args)
+    import ctypes.util
+    if not ctypes.util.find_library("fuse") and \
+            not ctypes.util.find_library("fuse3"):
+        print("mount needs libfuse, which this system does not have; "
+              "the filesystem layer (seaweedfs_tpu.filesys) still works "
+              "as a library — see tests/test_filesys.py", file=sys.stderr)
+        return 1
+    print("FUSE binding not wired in this build; use the library API "
+          "(seaweedfs_tpu.filesys.wfs.WFS)", file=sys.stderr)
+    return 1
+
+
+def _wait(stoppable) -> int:
+    done = threading.Event()
+    grace.on_interrupt(stoppable.stop)
+    grace.on_interrupt(done.set)
+    try:
+        while not done.is_set():
+            time.sleep(0.5)
+    finally:
+        grace.run_hooks()
+    return 0

@@ -20,8 +20,8 @@ def fix_orientation(data: bytes, mime: str = "image/jpeg") -> bytes:
     try:
         img = Image.open(io.BytesIO(data))
         orientation = img.getexif().get(274, 1)  # 274 = Orientation
-        if orientation in (0, 1):
-            return data
+        if orientation not in range(2, 9):
+            return data  # upright or corrupt tag: never re-encode
         # exif_transpose implements the full 8-state orientation table
         # (incl. the transpose/transverse cases 5 and 7) and clears the
         # tag on the result

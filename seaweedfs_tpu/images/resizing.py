@@ -32,39 +32,41 @@ def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
         return data, 0, 0
     ow, oh = img.size
     w, h = width or ow, height or oh
-    if mode == "fit":
-        # letterbox: scale to fit inside, pad to exact WxH
-        scaled = img.copy()
-        scaled.thumbnail((w, h))
-        canvas = Image.new(img.mode, (w, h))
-        canvas.paste(scaled, ((w - scaled.width) // 2,
-                              (h - scaled.height) // 2))
-        out = canvas
-    elif mode == "fill":
-        # cover: scale so both dims reach the target, center-crop
-        scale = max(w / ow, h / oh)
-        scaled = img.resize((max(1, round(ow * scale)),
-                             max(1, round(oh * scale))))
-        left = (scaled.width - w) // 2
-        top = (scaled.height - h) // 2
-        out = scaled.crop((left, top, left + w, top + h))
-    else:
+
+    def transform(frame):
+        if mode == "fit":
+            # letterbox: scale to fit inside, pad to exact WxH
+            scaled = frame.copy()
+            scaled.thumbnail((w, h))
+            canvas = Image.new(frame.mode, (w, h))
+            canvas.paste(scaled, ((w - scaled.width) // 2,
+                                  (h - scaled.height) // 2))
+            return canvas
+        if mode == "fill":
+            # cover: scale so both dims reach the target, center-crop
+            fw, fh = frame.size
+            scale = max(w / fw, h / fh)
+            scaled = frame.resize((max(1, round(fw * scale)),
+                                   max(1, round(fh * scale))))
+            left = (scaled.width - w) // 2
+            top = (scaled.height - h) // 2
+            return scaled.crop((left, top, left + w, top + h))
         # default: fit within the box preserving aspect ratio
-        out = img.copy()
+        out = frame.copy()
         out.thumbnail((w, h))
+        return out
+
+    out = transform(img)
     buf = io.BytesIO()
     fmt = _FORMATS[mime]
     if fmt == "JPEG" and out.mode not in ("RGB", "L"):
         out = out.convert("RGB")
     if fmt == "GIF" and getattr(img, "n_frames", 1) > 1:
-        # animated GIF: resize every frame, keep the animation (the
-        # reference resizes frame-by-frame too, resizing.go)
+        # animated GIF: apply the SAME transform to every frame, keep
+        # the animation (the reference resizes frame-by-frame too)
         from PIL import ImageSequence
-        frames = []
-        for frame in ImageSequence.Iterator(img):
-            f = frame.copy()
-            f.thumbnail((w, h))
-            frames.append(f)
+        frames = [transform(frame.copy())
+                  for frame in ImageSequence.Iterator(img)]
         frames[0].save(buf, format="GIF", save_all=True,
                        append_images=frames[1:],
                        duration=img.info.get("duration", 100),

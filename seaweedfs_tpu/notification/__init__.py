@@ -70,9 +70,25 @@ class LogQueue(MessageQueue):
         return out
 
 
+def _gated(name: str, package: str) -> Callable[..., MessageQueue]:
+    """Factory for broker backends whose client SDK is not in this
+    image (reference ships kafka/sqs/pubsub backends behind the same
+    interface): config naming them fails loudly with the remedy."""
+    def factory(*a, **kw):
+        raise RuntimeError(
+            f"notification backend {name!r} needs the {package} client "
+            f"library, which is not in this image; use 'log' (durable "
+            f"file queue) or 'memory', or install {package}")
+    return factory
+
+
 _REGISTRY: Dict[str, Callable[..., MessageQueue]] = {
     "memory": MemoryQueue,
     "log": LogQueue,
+    "kafka": _gated("kafka", "kafka-python"),
+    "aws_sqs": _gated("aws_sqs", "boto3"),
+    "google_pub_sub": _gated("google_pub_sub", "google-cloud-pubsub"),
+    "gocdk_pub_sub": _gated("gocdk_pub_sub", "a Go CDK bridge"),
 }
 
 

@@ -19,9 +19,10 @@ from seaweedfs_tpu.replication.source import FilerSource
 
 
 class _OneWay:
-    def __init__(self, src_url: str, dst_url: str, path_prefix: str):
+    def __init__(self, src_url: str, dst_url: str, path_prefix: str,
+                 replicator: Optional[Replicator] = None):
         self.src_url = src_url
-        self.replicator = Replicator(
+        self.replicator = replicator or Replicator(
             FilerSource(src_url), FilerSink(dst_url),
             path_filter=path_prefix)
         self.path_prefix = path_prefix

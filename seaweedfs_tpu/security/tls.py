@@ -57,9 +57,8 @@ class TlsConfig:
 
 
 def load_tls_config(security_conf, component: str) -> TlsConfig:
-    """[grpc.ca] + [grpc.<component>] cert/key, falling back to
-    [grpc.client] for dialing roles (reference tls.go LoadClientTLS /
-    LoadServerTLS)."""
+    """[grpc.ca] + [grpc.<component>] cert/key (reference tls.go
+    LoadClientTLS / LoadServerTLS)."""
     if security_conf is None or not security_conf:
         return TlsConfig()
     ca = security_conf.get_string("grpc.ca")
@@ -75,6 +74,12 @@ def configure_process_tls(security_conf, server_role: str) -> None:
     from seaweedfs_tpu import rpc
     server_tls = load_tls_config(security_conf, server_role)
     client_tls = load_tls_config(security_conf, "client")
+    if not client_tls.enabled and server_tls.enabled:
+        # no [grpc.client] section: dial with the role's own cert
+        # (reference tls.go — each component reuses its pair), or a
+        # server-sections-only config would listen secured but dial
+        # plaintext and the cluster would never form
+        client_tls = server_tls
     if server_tls.enabled:
         rpc.set_server_credentials(server_tls.server_credentials())
         log.info("grpc server TLS enabled (%s)", server_role)

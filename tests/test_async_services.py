@@ -56,8 +56,11 @@ class TestNotification:
         assert isinstance(new_queue("memory"), MemoryQueue)
         assert isinstance(
             new_queue("log", path=str(tmp_path / "l.log")), LogQueue)
-        with pytest.raises(ValueError):
+        # kafka is registered but gated on its missing client library
+        with pytest.raises(RuntimeError, match="kafka"):
             new_queue("kafka")
+        with pytest.raises(ValueError):
+            new_queue("never-heard-of-it")
 
 
 class TestReplicationSinks:
@@ -224,3 +227,64 @@ class TestMessageBroker:
         pub.close()
         client.delete_topic("ns", "temp")
         assert ("ns", "temp") not in broker._topics
+
+
+# -- cloud sinks (VERDICT missing #8) -----------------------------------------
+
+
+def test_object_store_sink_replicates_to_own_s3_gateway(tmp_path):
+    """The s3/gcs/b2 sink speaks real SigV4 against our own S3 gateway:
+    entry create/update/delete land as object PUT/DELETE (reference
+    sink/s3sink semantics)."""
+    from seaweedfs_tpu.pb import filer_pb2
+    from seaweedfs_tpu.replication.sinks import make_sink
+    from seaweedfs_tpu.s3api import Credential, Iam, Identity, S3ApiServer
+    from seaweedfs_tpu.s3api.auth import ACTION_ADMIN
+    from seaweedfs_tpu.util.s3_client import S3Client
+    from tests.cluster_util import Cluster, free_port_pair
+
+    access, secret = "SINKKEY", "SINKSECRET"
+    c = Cluster(tmp_path / "c", n_volume_servers=1, with_filer=True)
+    s3srv = S3ApiServer(
+        filer_url=c.filer.url, port=free_port_pair(),
+        iam=Iam([Identity(name="admin",
+                          credentials=[Credential(access, secret)],
+                          actions=[ACTION_ADMIN])]))
+    s3srv.start()
+    try:
+        client = S3Client(s3srv.url, access, secret)
+        client.create_bucket("repl")
+        sink = make_sink("s3", endpoint=s3srv.url, bucket="repl",
+                         access_key=access, secret_key=secret,
+                         directory="mirror")
+        e = filer_pb2.Entry(name="doc.txt")
+        sink.create_entry("/data/doc.txt", e, b"replicated-bytes")
+        assert client.get_object("repl", "mirror/data/doc.txt") == \
+            b"replicated-bytes"
+        sink.create_entry("/data/doc.txt", e, b"updated-bytes")
+        assert client.get_object("repl", "mirror/data/doc.txt") == \
+            b"updated-bytes"
+        sink.delete_entry("/data/doc.txt", is_directory=False)
+        assert client.head_object("repl", "mirror/data/doc.txt") is None
+        # directory delete sweeps the prefix
+        sink.create_entry("/data/a", filer_pb2.Entry(name="a"), b"1")
+        sink.create_entry("/data/b", filer_pb2.Entry(name="b"), b"2")
+        sink.delete_entry("/data", is_directory=True)
+        assert client.head_object("repl", "mirror/data/a") is None
+        assert client.head_object("repl", "mirror/data/b") is None
+    finally:
+        s3srv.stop()
+        c.stop()
+
+
+def test_sink_registry_and_gated_backends():
+    import pytest as _pytest
+    from seaweedfs_tpu.replication.sinks import make_sink
+    from seaweedfs_tpu import notification
+
+    with _pytest.raises(ValueError):
+        make_sink("bogus")
+    with _pytest.raises(RuntimeError, match="azure"):
+        make_sink("azure", endpoint="x", bucket="y")
+    with _pytest.raises(RuntimeError, match="kafka"):
+        notification.new_queue("kafka")

@@ -235,3 +235,63 @@ def test_backup_incremental(cli_cluster, tmp_path):
         # only asserts growth when the second upload landed on the same
         # volume (assignment is free to pick another one)
         assert os.path.getsize(bdir / f"{vid}.dat") > size1
+
+
+def test_filer_replicate_to_local_sink(tmp_path):
+    """`filer.replicate` tails a filer and mirrors writes into the
+    enabled [sink.local] directory (reference filer_replication.go)."""
+    mport, vport, fport = free_port(), free_port(), free_port()
+    tmp = tmp_path
+    mirror = tmp / "mirror"
+    (tmp / "replication.toml").write_text(f"""
+[source.filer]
+grpcAddress = "127.0.0.1:{fport}"
+directory = "/"
+
+[sink.local]
+enabled = true
+directory = "{mirror}"
+""")
+    procs = []
+    try:
+        procs.append(spawn_cli(
+            "master", "-port", str(mport), "-mdir", str(tmp / "m")))
+        wait_http(f"http://127.0.0.1:{mport}/cluster/status")
+        procs.append(spawn_cli(
+            "volume", "-port", str(vport), "-dir", str(tmp / "v"),
+            "-mserver", f"127.0.0.1:{mport}", "-pulseSeconds", "0.3"))
+        wait_http(f"http://127.0.0.1:{vport}/status")
+        procs.append(spawn_cli(
+            "filer", "-port", str(fport), "-master",
+            f"127.0.0.1:{mport}", "-dir", str(tmp / "f")))
+        wait_http(f"http://127.0.0.1:{fport}/?pretty=y")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "filer.replicate",
+             "-config", str(tmp / "replication.toml")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            cwd=REPO, env=env))
+        time.sleep(1.5)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/docs/mirrored.txt",
+            data=b"replicated!", method="POST")
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+        deadline = time.monotonic() + 20
+        target = mirror / "docs" / "mirrored.txt"
+        while time.monotonic() < deadline:
+            if target.exists() and target.read_bytes() == b"replicated!":
+                break
+            time.sleep(0.3)
+        assert target.exists(), list(mirror.rglob("*")) if \
+            mirror.exists() else "mirror dir never created"
+        assert target.read_bytes() == b"replicated!"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
