@@ -188,18 +188,24 @@ def _filer_parser() -> argparse.ArgumentParser:
                    help="auto-chunking split size")
     p.add_argument("-encryptVolumeData", dest="cipher",
                    action="store_true")
+    p.add_argument("-peers", default="",
+                   help="comma-separated host:port of ALL filers in "
+                        "this cluster (merged metadata view)")
     return p
 
 
 def _build_filer(opts):
     from seaweedfs_tpu.server.filer import FilerServer
     os.makedirs(opts.dir, exist_ok=True)
+    peers = [x.strip() for x in (opts.peers or "").split(",")
+             if x.strip()]
     return FilerServer(
         opts.master, ip=opts.ip, port=opts.port, store=opts.store,
         meta_dir=opts.dir, collection=opts.collection,
         replication=opts.replication,
         chunk_size=opts.max_mb << 20, cipher=opts.cipher,
-        cache_dir=os.path.join(opts.dir, "cache"))
+        cache_dir=os.path.join(opts.dir, "cache"),
+        peers=peers)
 
 
 @command("filer", "start a filer (namespace server)")

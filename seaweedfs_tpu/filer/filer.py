@@ -71,6 +71,10 @@ class Filer:
         # optional external queue: every event also published there
         # (reference filer.notify → weed/notification)
         self.notification_queue = None
+        # store signature for multi-filer loop prevention + merged-view
+        # fast path (wired by FilerServer / MetaAggregator)
+        self.signature: int = 0
+        self.on_meta_event: Optional[Callable[[], None]] = None
 
     def _delete_chunks(self, chunks: List[filer_pb2.FileChunk]) -> None:
         """Hand chunks to the GC hook, expanding manifest chunks first.
@@ -108,7 +112,16 @@ class Filer:
             ev.new_entry.CopyFrom(new)
         if new_parent_path:
             ev.new_parent_path = new_parent_path
+        if self.signature:
+            # store-signature loop guard: peers recognize and drop this
+            # filer's own events (reference meta_aggregator.go:94-118)
+            ev.signatures.append(self.signature)
         self.meta_log.append_event(directory, ev)
+        if self.on_meta_event is not None:
+            try:
+                self.on_meta_event()  # wake merged-view subscribers
+            except Exception:
+                pass  # the merged view is best-effort; local log is canonical
         if self.notification_queue is not None:
             try:
                 self.notification_queue.send_message(directory, ev)

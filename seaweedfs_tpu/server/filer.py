@@ -47,7 +47,8 @@ class FilerServer:
                  collection: str = "", replication: str = "",
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  cipher: bool = False,
-                 cache_dir: Optional[str] = None):
+                 cache_dir: Optional[str] = None,
+                 peers: Optional[List[str]] = None):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -81,6 +82,18 @@ class FilerServer:
         # namespace; reference filer_conf.go) — loaded lazily, reloaded
         # whenever that path is written through this filer
         self.filer_conf = filer_conf_mod.FilerConf()
+        # multi-filer: merge peer filers' local logs into one view
+        # (reference filer/meta_aggregator.go)
+        import random
+        self.filer.signature = random.randint(1, 0x7FFFFFFF)
+        self.meta_aggregator = None
+        if peers:
+            from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
+            self.meta_aggregator = MetaAggregator(
+                self.filer, f"{ip}:{port}", peers,
+                signature=self.filer.signature,
+                log_dir=f"{meta_dir}/aggr-logs" if meta_dir else None)
+            self.filer.on_meta_event = self.meta_aggregator.wake
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -124,6 +137,8 @@ class FilerServer:
             name=f"filer-http-{self.port}", daemon=True)
         self._http_thread.start()
         self.master_client.start()
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.start()
         self.reload_filer_conf()
         log.info("filer %s:%d started (store=%s, master=%s)",
                  self.ip, self.port, type(self.filer.store).__name__,
@@ -131,6 +146,8 @@ class FilerServer:
 
     def stop(self) -> None:
         self._stopping = True
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.stop()
         self.master_client.stop()
         if self._http_server:
             self._http_server.shutdown()
@@ -335,6 +352,23 @@ class FilerServer:
     # -- gRPC: subscriptions --------------------------------------------------
 
     def SubscribeMetadata(self, request, context):
+        """Cluster-wide merged stream when peers are configured (the
+        MetaAggregator view); the local log otherwise."""
+        if self.meta_aggregator is not None:
+            agg = self.meta_aggregator
+            since = request.since_ns
+            while context.is_active() and not self._stopping:
+                events = agg.events_since(
+                    since, path_prefix=request.path_prefix)
+                for ev in events:
+                    yield ev
+                    since = max(since, ev.ts_ns)
+                if not events:
+                    agg.wait_for_data(since, timeout=0.5)
+            return
+        yield from self.SubscribeLocalMetadata(request, context)
+
+    def SubscribeLocalMetadata(self, request, context):
         since = request.since_ns
         while context.is_active() and not self._stopping:
             events = self.filer.meta_log.read_events_since(
@@ -344,8 +378,6 @@ class FilerServer:
                 since = max(since, ev.ts_ns)
             if not events:
                 self.filer.meta_log.wait_for_data(since, timeout=0.5)
-
-    SubscribeLocalMetadata = SubscribeMetadata
 
     # -- gRPC: KV -------------------------------------------------------------
 
