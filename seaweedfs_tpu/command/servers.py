@@ -93,6 +93,8 @@ def _build_master(opts):
         peers=peers,
         maintenance_scripts=list(scripts),
         maintenance_interval_s=float(sleep_minutes) * 60,
+        sequencer_type=conf.get_string("master.sequencer.type", "memory"),
+        sequencer_node_id=conf.get("master.sequencer.node_id"),
     )
 
 
@@ -264,6 +266,22 @@ def _webdav_parser() -> argparse.ArgumentParser:
     p.add_argument("-port", type=int, default=7333)
     p.add_argument("-filer", default="127.0.0.1:8888")
     return p
+
+
+@command("ftp", "start an FTP gateway over the filer")
+def run_ftp(args) -> int:
+    _setup_tls("client")
+    p = argparse.ArgumentParser(prog="ftp")
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=2121)
+    p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-ftpRoot", dest="ftp_root", default="/")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.ftpd import FtpServer
+    srv = FtpServer(opts.filer, ip=opts.ip, port=opts.port,
+                    ftp_root=opts.ftp_root)
+    srv.start()
+    return _serve_forever([srv])
 
 
 @command("webdav", "start a WebDAV gateway")

@@ -163,7 +163,9 @@ scripts = [
 sleep_minutes = 17
 
 [master.sequencer]
-type = "memory"  # or "snowflake"
+type = "memory"  # or "snowflake" (coordination-free time-based ids)
+# snowflake only: unique 0-1023 per master (default: hash of ip:port)
+#node_id = 1
 
 # cloud-tier targets for `volume.tier.upload` (reference scaffold.go
 # [storage.backend.s3.default]); volume servers read this section too

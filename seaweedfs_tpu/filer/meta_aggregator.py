@@ -54,6 +54,7 @@ class MetaAggregator:
         # durable, locally-timestamped log of PEER events
         self.aggr_log = MetaLog(log_dir)
         self._cond = threading.Condition()
+        self.version = 0   # bumps on every local wake or peer append
         self._stopping = False
         self._threads: List[threading.Thread] = []
         self._calls: Dict[str, object] = {}
@@ -132,6 +133,7 @@ class MetaAggregator:
     def wake(self) -> None:
         """Local-write hook: merged-view subscribers re-read both logs."""
         with self._cond:
+            self.version += 1
             self._cond.notify_all()
 
     def _follow_peer(self, peer: str) -> None:
@@ -153,6 +155,7 @@ class MetaAggregator:
                         # re-stamped with a LOCAL ts by append_event
                         self.aggr_log.append_event(rec.directory, ev)
                         with self._cond:
+                            self.version += 1
                             self._cond.notify_all()
                     self._mark_progress(peer, since)
             except grpc.RpcError:
@@ -179,7 +182,12 @@ class MetaAggregator:
         out.sort(key=lambda e: e.ts_ns)
         return out
 
-    def wait_for_data(self, after_ts_ns: int, timeout: float) -> bool:
+    def wait_for_version(self, seen_version: int, timeout: float) -> bool:
+        """Block until something was appended after the caller read
+        `version` (no lost wakeups: an append between the caller's
+        events_since and this call returns immediately)."""
         with self._cond:
+            if self.version != seen_version:
+                return True
             self._cond.wait(timeout)
-        return True  # caller re-reads both logs either way
+            return self.version != seen_version

@@ -1,0 +1,4 @@
+"""FTP gateway over the filer (reference weed/ftpd — an 81-LoC
+library-backed skeleton; here a small self-contained server)."""
+
+from seaweedfs_tpu.ftpd.server import FtpServer  # noqa: F401

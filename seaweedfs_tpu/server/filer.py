@@ -84,8 +84,18 @@ class FilerServer:
         self.filer_conf = filer_conf_mod.FilerConf()
         # multi-filer: merge peer filers' local logs into one view
         # (reference filer/meta_aggregator.go)
+        # the signature must SURVIVE restarts (reference persists it in
+        # the store): events written before a restart must still be
+        # recognizable as our own
         import random
-        self.filer.signature = random.randint(1, 0x7FFFFFFF)
+        import struct as _struct
+        sig_blob = backend.kv_get(b"filer.store.signature")
+        if sig_blob and len(sig_blob) == 4:
+            self.filer.signature = _struct.unpack(">i", sig_blob)[0]
+        else:
+            self.filer.signature = random.randint(1, 0x7FFFFFFF)
+            backend.kv_put(b"filer.store.signature",
+                           _struct.pack(">i", self.filer.signature))
         self.meta_aggregator = None
         if peers:
             from seaweedfs_tpu.filer.meta_aggregator import MetaAggregator
@@ -358,13 +368,14 @@ class FilerServer:
             agg = self.meta_aggregator
             since = request.since_ns
             while context.is_active() and not self._stopping:
+                ver = agg.version  # read BEFORE scanning: no lost wakeups
                 events = agg.events_since(
                     since, path_prefix=request.path_prefix)
                 for ev in events:
                     yield ev
                     since = max(since, ev.ts_ns)
                 if not events:
-                    agg.wait_for_data(since, timeout=0.5)
+                    agg.wait_for_version(ver, timeout=0.5)
             return
         yield from self.SubscribeLocalMetadata(request, context)
 

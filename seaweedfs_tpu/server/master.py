@@ -77,13 +77,31 @@ class MasterServer:
                  peers: Optional[List[str]] = None,
                  raft_election_timeout: float = 0.5,
                  maintenance_scripts: Optional[List[str]] = None,
-                 maintenance_interval_s: float = 17 * 60):
+                 maintenance_interval_s: float = 17 * 60,
+                 sequencer_type: str = "memory",
+                 sequencer_node_id: Optional[int] = None):
         self.ip = ip
         self.port = port
         self.meta_dir = meta_dir
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
-        seq = MemorySequencer(start=self._load_sequence())
+        if sequencer_type == "snowflake":
+            # coordination-free ids (reference [master.sequencer]
+            # type=snowflake; the etcd kind needs an etcd server).
+            # node_id must differ per master: configured explicitly, or
+            # derived from ip:port (NOT the port alone — multi-master
+            # clusters conventionally share a port across hosts)
+            from seaweedfs_tpu.topology.sequence import SnowflakeSequencer
+            import zlib
+            node_id = sequencer_node_id if sequencer_node_id is not None \
+                else zlib.crc32(f"{ip}:{port}".encode()) & 0x3FF
+            seq = SnowflakeSequencer(node_id=node_id)
+        elif sequencer_type in ("memory", ""):
+            seq = MemorySequencer(start=self._load_sequence())
+        else:
+            raise ValueError(
+                f"unknown sequencer type {sequencer_type!r} "
+                "(memory | snowflake; etcd needs an etcd server)")
         self.topo = Topology(volume_size_limit=volume_size_limit_mb << 20,
                              sequencer=seq, pulse_seconds=pulse_seconds)
         self.growth = VolumeGrowth(self.topo)
@@ -173,6 +191,8 @@ class MasterServer:
         return 1
 
     def _save_sequence(self) -> None:
+        if not getattr(self.topo.sequence, "persistable", True):
+            return  # snowflake ids must not seed a later memory run
         p = self._sequence_path()
         if p:
             os.makedirs(self.meta_dir, exist_ok=True)
@@ -277,7 +297,10 @@ class MasterServer:
         check-then-allocate window is atomic: no id >= the committed
         watermark is ever handed out, and a failed-over leader resuming
         at the watermark can never duplicate one."""
-        if not self.raft.peers:
+        if not self.raft.peers or \
+                not getattr(self.topo.sequence, "needs_watermark", True):
+            # time-based sequencers are collision-free without raft;
+            # watermarking them would raft-propose on ~every assign
             return
         peek = self.topo.sequence.peek
         if peek + count >= self._seq_watermark:
@@ -697,6 +720,14 @@ def _make_http_handler(ms: MasterServer):
         def log_message(self, fmt, *args):  # quiet
             pass
 
+        def _html(self, body: str, code: int = 200) -> None:
+            blob = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
         def _json(self, payload: dict, code: int = 200) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
@@ -755,9 +786,39 @@ def _make_http_handler(ms: MasterServer):
                 self._json({"compacted": vids})
             elif u.path == "/cluster/status":
                 self._json(ms.http_cluster_status())
+            elif u.path in ("/", "/ui"):
+                self._html(_master_ui(ms))
             else:
                 self._json({"error": f"unknown path {u.path}"}, code=404)
 
         do_POST = do_GET
 
     return Handler
+
+
+def _master_ui(ms: MasterServer) -> str:
+    """Plain status page (reference master UI, server/master_ui/).
+    Every interpolated string is escaped — node urls, rack names etc.
+    originate from heartbeats, i.e. remote input."""
+    import html as _html
+    esc = _html.escape
+    rows = []
+    for node in ms.topo.nodes():
+        rows.append(
+            f"<tr><td>{esc(node.url)}</td><td>{len(node.volumes)}"
+            f"/{node.max_volumes}</td><td>{len(node.ec_shards)}</td>"
+            f"<td>{esc(node.rack.id if node.rack else '')}</td></tr>")
+    raft = ms.raft
+    return (
+        "<html><head><title>seaweedfs-tpu master</title></head><body>"
+        f"<h1>Master {esc(ms.url)}</h1>"
+        f"<p>leader: {esc(raft.leader() or '?')} | "
+        f"is_leader: {raft.is_leader}"
+        f" | peers: {esc(', '.join(raft.peers)) or '(single)'}"
+        f" | volume size limit: {ms.topo.volume_size_limit >> 20} MB</p>"
+        "<h2>Topology</h2><table border=1 cellpadding=4>"
+        "<tr><th>volume server</th><th>volumes</th><th>ec shards</th>"
+        "<th>rack</th></tr>" + "".join(rows) + "</table>"
+        "<p><a href=/dir/status>dir status (json)</a> | "
+        "<a href=/cluster/status>cluster status (json)</a></p>"
+        "</body></html>")

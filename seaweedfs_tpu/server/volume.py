@@ -906,6 +906,29 @@ def _make_http_handler(vs: VolumeServer):
             if u.path == "/status":
                 self._json(self.server_status())
                 return
+            if u.path in ("/ui", "/ui/"):
+                import html as _html
+                st = self.server_status()
+                rows = "".join(
+                    f"<tr><td>{v['id']}</td>"
+                    f"<td>{_html.escape(v.get('collection') or '')}"
+                    f"</td><td>{v['size']}</td><td>{v['file_count']}</td>"
+                    f"<td>{'ro' if v.get('read_only') else 'rw'}</td></tr>"
+                    for v in st["Volumes"])
+                body = ("<html><head><title>seaweedfs-tpu volume</title>"
+                        f"</head><body><h1>Volume server {vs.url}</h1>"
+                        f"<p>master: {vs.current_master}</p>"
+                        "<table border=1 cellpadding=4><tr><th>vid</th>"
+                        "<th>collection</th><th>size</th><th>files</th>"
+                        "<th>mode</th></tr>" + rows + "</table>"
+                        "</body></html>").encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             try:
                 f, params = self._parse_path()
             except ValueError as e:

@@ -12,6 +12,10 @@ import threading
 
 
 class MemorySequencer:
+    # contiguous ids: the master raft-watermarks and snapshots them
+    needs_watermark = True
+    persistable = True
+
     def __init__(self, start: int = 1):
         self._next = max(1, start)
         self._lock = threading.Lock()
@@ -32,3 +36,67 @@ class MemorySequencer:
     @property
     def peek(self) -> int:
         return self._next
+
+
+class SnowflakeSequencer:
+    """Coordination-free unique ids: 41-bit millisecond timestamp,
+    10-bit node id, 12-bit per-ms counter (the reference's snowflake
+    option in master.toml [master.sequencer]; its etcd kind needs an
+    etcd server and is not available in this image).
+
+    Ids are unique across masters WITHOUT raft/etcd coordination, at
+    the cost of non-contiguous key space.
+    """
+
+    EPOCH_MS = 1_600_000_000_000  # 2020-09-13, keeps 41 bits ample
+    MAX_COUNTER = 0xFFF
+    # time-based ids: no raft watermark needed, and snapshotting the
+    # huge timestamp ids into sequence.json would poison a later
+    # memory-sequencer restart
+    needs_watermark = False
+    persistable = False
+
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id & 0x3FF
+        self._lock = threading.Lock()
+        self._last_ms = 0
+        self._counter = -1
+
+    def _advance_ms(self) -> None:
+        import time
+        now_ms = int(time.time() * 1000) - self.EPOCH_MS
+        # logical advance: reserving a near-future millisecond block is
+        # cheaper than spinning and ids stay unique either way
+        self._last_ms = max(now_ms, self._last_ms + 1)
+        self._counter = -1
+
+    def next_batch(self, count: int = 1) -> int:
+        """Returns the first of `count` CONSECUTIVE ids. The range must
+        fit one millisecond block (4096 ids) or first+count-1 would
+        bleed into the node-id bits and collide with another master."""
+        if count > self.MAX_COUNTER + 1:
+            raise ValueError(
+                f"snowflake cannot issue {count} consecutive ids "
+                f"(max {self.MAX_COUNTER + 1} per batch)")
+        with self._lock:
+            import time
+            now_ms = int(time.time() * 1000) - self.EPOCH_MS
+            if now_ms > self._last_ms:
+                self._last_ms = now_ms
+                self._counter = -1
+            if self._counter + count > self.MAX_COUNTER:
+                self._advance_ms()
+            first_counter = self._counter + 1
+            self._counter += count
+            return (self._last_ms << 22) | (self.node_id << 12) | \
+                first_counter
+
+    def set_max(self, seen: int) -> None:
+        pass  # time-based: never collides with observed ids
+
+    @property
+    def peek(self) -> int:
+        """Non-consuming: the id the next allocation would start at."""
+        with self._lock:
+            return (self._last_ms << 22) | (self.node_id << 12) | \
+                min(self._counter + 1, self.MAX_COUNTER)

@@ -352,3 +352,52 @@ def test_ec_encode_jax_backend_through_rpc(cluster):
         if parse_fid(fid).volume_id == vid:
             with cluster.fetch(fid) as r:
                 assert r.read() == d
+
+
+def test_admin_ui_pages(cluster):
+    """Master and volume servers serve plain HTML status pages
+    (reference server/*_ui)."""
+    with cluster.http(f"{cluster.master.url}/") as r:
+        body = r.read().decode()
+        assert r.headers.get("Content-Type", "").startswith("text/html")
+    assert "Master" in body and "Topology" in body
+    vs = cluster.volume_servers[0]
+    with cluster.http(f"{vs.url}/ui") as r:
+        vbody = r.read().decode()
+    assert "Volume server" in vbody
+
+
+def test_snowflake_sequencer_master(tmp_path):
+    """type=snowflake hands out globally-unique ids with no raft
+    coordination (reference [master.sequencer])."""
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from tests.cluster_util import free_port_pair
+    import json as _json
+    import urllib.request
+
+    m = MasterServer(port=free_port_pair(), sequencer_type="snowflake",
+                     pulse_seconds=0.2)
+    m.start()
+    vs = None
+    try:
+        d = tmp_path / "v"
+        d.mkdir()
+        vs = VolumeServer(master_url=m.url, directories=[str(d)],
+                          port=free_port_pair(), max_volume_counts=[10],
+                          pulse_seconds=0.2)
+        vs.start()
+        import time as _time
+        deadline = _time.time() + 10
+        while _time.time() < deadline and not m.topo.nodes():
+            _time.sleep(0.05)
+        fids = set()
+        for _ in range(5):
+            with urllib.request.urlopen(
+                    f"http://{m.url}/dir/assign", timeout=10) as r:
+                fids.add(_json.load(r)["fid"])
+        assert len(fids) == 5  # all unique
+    finally:
+        if vs is not None:
+            vs.stop()
+        m.stop()

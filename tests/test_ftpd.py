@@ -1,0 +1,63 @@
+"""FTP gateway tests using stdlib ftplib (reference weed/ftpd)."""
+
+import ftplib
+import io
+
+import pytest
+
+from seaweedfs_tpu.ftpd import FtpServer
+
+from tests.cluster_util import Cluster, free_port_pair
+
+
+@pytest.fixture(scope="module")
+def ftp_env(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("ftp"), n_volume_servers=1,
+                with_filer=True)
+    srv = FtpServer(c.filer.url, port=free_port_pair())
+    srv.start()
+    yield c, srv
+    srv.stop()
+    c.stop()
+
+
+def _client(srv) -> ftplib.FTP:
+    ftp = ftplib.FTP()
+    ftp.connect(srv.ip, srv.port, timeout=10)
+    ftp.login("anyone", "anything")
+    return ftp
+
+
+def test_ftp_store_retrieve_list_delete(ftp_env):
+    c, srv = ftp_env
+    ftp = _client(srv)
+    assert ftp.pwd() == "/"
+    ftp.storbinary("STOR /docs/hello.txt", io.BytesIO(b"over ftp"))
+    # readable through the filer HTTP side too
+    with c.http(f"{c.filer.url}/docs/hello.txt") as r:
+        assert r.read() == b"over ftp"
+    # and back through FTP
+    buf = io.BytesIO()
+    ftp.retrbinary("RETR /docs/hello.txt", buf.write)
+    assert buf.getvalue() == b"over ftp"
+    # listing
+    ftp.cwd("/docs")
+    names = ftp.nlst()
+    assert "hello.txt" in names
+    lines = []
+    ftp.retrlines("LIST", lines.append)
+    assert any("hello.txt" in l for l in lines)
+    # delete
+    ftp.delete("/docs/hello.txt")
+    names = ftp.nlst()
+    assert "hello.txt" not in names
+    ftp.quit()
+
+
+def test_ftp_unknown_command_keeps_session(ftp_env):
+    _, srv = ftp_env
+    ftp = _client(srv)
+    with pytest.raises(ftplib.error_perm):
+        ftp.sendcmd("SITE CHMOD 777 x")
+    assert ftp.pwd() == "/"  # session still alive
+    ftp.quit()
