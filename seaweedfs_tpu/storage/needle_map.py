@@ -14,6 +14,7 @@ SortedIndex provides binary search over a key-sorted index blob — the
 from __future__ import annotations
 
 import os
+import struct
 import threading
 from dataclasses import dataclass
 from typing import Optional, Tuple
