@@ -127,6 +127,11 @@ def _volume_parser() -> argparse.ArgumentParser:
                    default=0.0)
     p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
                    choices=["auto", "jax", "native", "numpy"])
+    p.add_argument("-index", dest="needle_map_kind", default="memory",
+                   choices=["memory", "kv"],
+                   help="needle map kind: memory (dict rebuild from .idx) "
+                        "or kv (persistent LogKV, O(live) reopen; reference "
+                        "command/volume.go:203-211 leveldb kinds)")
     p.add_argument("-cpuprofile", default=None)
     return p
 
@@ -160,7 +165,8 @@ def _build_volume(opts):
         rack=opts.rack, max_volume_counts=maxes,
         pulse_seconds=opts.pulse_seconds, ec_encoder=opts.ec_encoder,
         compaction_mbps=opts.compaction_mbps,
-        storage_backends=_storage_backend_conf())
+        storage_backends=_storage_backend_conf(),
+        needle_map_kind=opts.needle_map_kind)
 
 
 @command("volume", "start a volume server (data plane)")

@@ -199,6 +199,7 @@ def ec_shards_to_volume(store: Store, vid: int, collection: str = "",
     encoder.write_idx_file_from_ec_index(base)
     from seaweedfs_tpu.storage.volume import Volume
     with loc._lock:
-        v = Volume(loc.directory, collection, vid, create_if_missing=False)
+        v = Volume(loc.directory, collection, vid, create_if_missing=False,
+                   needle_map_kind=loc.needle_map_kind)
         loc.volumes[vid] = v
     store.new_volumes.append(store.volume_info(v))

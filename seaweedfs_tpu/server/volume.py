@@ -57,7 +57,8 @@ class VolumeServer:
                  rack: str = "", max_volume_counts: Optional[List[int]] = None,
                  pulse_seconds: float = 5.0, ec_encoder: str = "auto",
                  compaction_mbps: float = 0.0,
-                 storage_backends: Optional[dict] = None):
+                 storage_backends: Optional[dict] = None,
+                 needle_map_kind: str = "memory"):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -76,7 +77,8 @@ class VolumeServer:
         self.ec_encoder = ec_encoder
         self.compaction_mbps = compaction_mbps
         self.store = Store(directories, max_volume_counts, ip=ip, port=port,
-                           public_url=public_url)
+                           public_url=public_url,
+                           needle_map_kind=needle_map_kind)
         self.volume_size_limit = 30 << 30
         self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
         self._ec_locations: Dict[int, Tuple[float, Dict[int, List[str]]]] = {}

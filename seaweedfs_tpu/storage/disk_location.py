@@ -34,10 +34,12 @@ def parse_ec_shard_filename(name: str):
 
 
 class DiskLocation:
-    def __init__(self, directory: str, max_volume_count: int = 8):
+    def __init__(self, directory: str, max_volume_count: int = 8,
+                 needle_map_kind: str = "memory"):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
+        self.needle_map_kind = needle_map_kind
         self.volumes: Dict[int, Volume] = {}
         self.ec_volumes: Dict[int, "object"] = {}  # vid -> EcVolume (set by ec pkg)
         self._lock = threading.RLock()
@@ -52,7 +54,8 @@ class DiskLocation:
                 if vid not in self.volumes:
                     try:
                         self.volumes[vid] = Volume(
-                            self.directory, col, vid, create_if_missing=False)
+                            self.directory, col, vid, create_if_missing=False,
+                            needle_map_kind=self.needle_map_kind)
                     except Exception:
                         continue
             self._load_ec_shards()
@@ -89,6 +92,7 @@ class DiskLocation:
         with self._lock:
             if vid in self.volumes:
                 return self.volumes[vid]
+            kwargs.setdefault("needle_map_kind", self.needle_map_kind)
             v = Volume(self.directory, collection, vid, **kwargs)
             self.volumes[vid] = v
             return v

@@ -31,6 +31,23 @@ class NeedleValue:
     size: int    # body size; TOMBSTONE/negative = deleted
 
 
+def read_index_array(path: str):
+    """Read a .idx file as a parsed numpy record array, truncating any
+    torn trailing partial entry (crash mid-append) on disk first — the
+    file is about to be reopened for append, and a torn tail would land
+    every later entry misaligned. Returns None if the file is absent."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        buf = f.read()
+    usable = len(buf) - (len(buf) % t.NEEDLE_MAP_ENTRY_SIZE)
+    if usable != len(buf):
+        with open(path, "r+b") as f:
+            f.truncate(usable)
+        buf = buf[:usable]
+    return idx_codec.parse_index_bytes(buf)
+
+
 class NeedleMap:
     """Dict-backed needle map bound to an append-only .idx file."""
 
@@ -51,19 +68,8 @@ class NeedleMap:
     # -- loading -------------------------------------------------------------
 
     def _load(self, path: str) -> None:
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            buf = f.read()
-        # a torn trailing partial entry (crash mid-append) must be cut off
-        # BEFORE we reopen for append, or every later entry lands misaligned
-        usable = len(buf) - (len(buf) % t.NEEDLE_MAP_ENTRY_SIZE)
-        if usable != len(buf):
-            with open(path, "r+b") as f:
-                f.truncate(usable)
-            buf = buf[:usable]
-        arr = idx_codec.parse_index_bytes(buf)
-        if not len(arr):
+        arr = read_index_array(path)
+        if arr is None or not len(arr):
             return
         keys = arr["key"]
         sizes = arr["size"].astype(np.int64)
@@ -193,9 +199,25 @@ class KvNeedleMap(NeedleMap):
     volumes reload in O(live) instead of O(history). Stats are
     recomputed from the .idx with the same vectorized pass the memory
     map uses (cheap: numpy over 16B records, no dict building).
+
+    Crash reconciliation: the .idx append is buffered and the KV has
+    its own flush cadence, so after a crash either side may lag. Every
+    KV record embeds the 1-based .idx sequence number of the op that
+    produced it — ONE atomic LogKV record per op (deletes are tombstone
+    puts, not KV deletes, so they carry a seq too; LogKV replay is
+    record-atomic, so there is no torn window between an entry and a
+    separate watermark record). On load, the high-water mark is
+    max(seq) over the scan the stats pass already does: a lagging KV
+    replays just the missing .idx tail (idempotent, in order); a KV
+    that ran AHEAD of the durable .idx is wiped and rebuilt, because
+    the .idx is canon. The old all-or-nothing "repair only when the KV
+    is empty" heuristic let acked writes 404 after a crash; this
+    replaces it (the reference leveldb map gets the same atomicity
+    from a WriteBatch, needle_map_leveldb.go).
     """
 
-    ENTRY = struct.Struct(">Qi")  # offset u64, size i32
+    ENTRY = struct.Struct(">QiQ")  # offset u64, size i32, idx-seq u64
+    _PFX = b"n"                    # needle entries: b"n" + u64 key
 
     def __init__(self, index_path: str):
         from seaweedfs_tpu.filer.stores.kv_store import LogKV
@@ -211,60 +233,82 @@ class KvNeedleMap(NeedleMap):
         self.content_size = 0
         self.deleted_size = 0
         self.max_key = 0
+        self._live_count = 0
+        self._idx_entries = 0      # total .idx entries (durable + buffered)
         self._load_stats(index_path)
         self._index_file = open(index_path, "ab")
 
-    @staticmethod
-    def _key(key: int) -> bytes:
-        return struct.pack(">Q", key)
+    @classmethod
+    def _key(cls, key: int) -> bytes:
+        return cls._PFX + struct.pack(">Q", key)
+
+    def _scan_applied(self) -> int:
+        """High-water mark: how many .idx entries the KV reflects."""
+        applied = 0
+        for _, v in self._kv.scan(self._PFX):
+            seq = self.ENTRY.unpack(v)[2]
+            if seq > applied:
+                applied = seq
+        return applied
+
+    def _replay_op(self, i: int, key: int, offset: int, size: int) -> None:
+        self._kv.put(self._key(key), self.ENTRY.pack(offset, size, i + 1))
+
+    def _reconcile(self, arr, sizes) -> None:
+        """Bring the KV in line with the canonical .idx after a crash."""
+        n_idx = len(arr)
+        applied = self._scan_applied()
+        if applied > n_idx:
+            # KV outran the durable .idx (crash before the buffered
+            # .idx batch hit disk). The .idx is canon: rebuild.
+            self._kv.delete_prefix(b"")
+            applied = 0
+        for i in range(applied, n_idx):
+            size = int(sizes[i])
+            self._replay_op(i, int(arr["key"][i]),
+                            int(arr["offset"][i]) if size >= 0 else 0,
+                            size if size >= 0 else t.TOMBSTONE_SIZE)
+        self._idx_entries = n_idx
 
     def _load_stats(self, path: str) -> None:
-        if not os.path.exists(path):
-            return
-        with open(path, "rb") as f:
-            buf = f.read()
-        usable = len(buf) - (len(buf) % t.NEEDLE_MAP_ENTRY_SIZE)
-        if usable != len(buf):
-            with open(path, "r+b") as f:
-                f.truncate(usable)
-            buf = buf[:usable]
-        arr = idx_codec.parse_index_bytes(buf)
-        if not len(arr):
+        arr = read_index_array(path)
+        if arr is None or not len(arr):
+            # no .idx → any KV content is a phantom from a lost file
+            if len(self._kv):
+                self._kv.delete_prefix(b"")
             return
         sizes = arr["size"].astype(np.int64)
+        self._reconcile(arr, sizes)
         puts = sizes >= 0
         self.file_count = int(puts.sum())
         self.content_size = int(sizes[puts].sum())
         self.max_key = int(arr["key"].max())
-        live = sum(1 for _ in self._kv.scan(b""))
-        live_size = sum(
-            self.ENTRY.unpack(v)[1]
-            for _, v in self._kv.scan(b""))
+        live = 0
+        live_size = 0
+        for _, v in self._kv.scan(self._PFX):
+            _, size, _ = self.ENTRY.unpack(v)
+            if not t.size_is_deleted(size):
+                live += 1
+                live_size += size
+        self._live_count = live
         self.deleted_count = self.file_count - live
         self.deleted_size = self.content_size - live_size
-        # idx longer than the kv state (crash between idx append and kv
-        # put): replay the missing tail into the kv
-        if self.file_count and live == 0 and len(arr):
-            for i in range(len(arr)):
-                size = int(sizes[i])
-                key = int(arr["key"][i])
-                if size >= 0:
-                    self._kv.put(self._key(key),
-                                 self.ENTRY.pack(int(arr["offset"][i]),
-                                                 size))
-                else:
-                    self._kv.delete(self._key(key))
-            live = len(self._kv)
 
     def put(self, key: int, offset: int, size: int) -> None:
         with self._lock:
             prev = self._kv.get(self._key(key))
             if prev is not None:
-                _, prev_size = self.ENTRY.unpack(prev)
+                _, prev_size, _ = self.ENTRY.unpack(prev)
                 if not t.size_is_deleted(prev_size):
                     self.deleted_count += 1
                     self.deleted_size += prev_size
-            self._kv.put(self._key(key), self.ENTRY.pack(offset, size))
+                else:
+                    self._live_count += 1
+            else:
+                self._live_count += 1
+            self._idx_entries += 1
+            self._kv.put(self._key(key),
+                         self.ENTRY.pack(offset, size, self._idx_entries))
             self.file_count += 1
             self.content_size += size
             self.max_key = max(self.max_key, key)
@@ -274,7 +318,7 @@ class KvNeedleMap(NeedleMap):
         blob = self._kv.get(self._key(key))
         if blob is None:
             return None
-        offset, size = self.ENTRY.unpack(blob)
+        offset, size, _ = self.ENTRY.unpack(blob)
         if t.size_is_deleted(size):
             return None
         return NeedleValue(offset=offset, size=size)
@@ -284,10 +328,14 @@ class KvNeedleMap(NeedleMap):
             blob = self._kv.get(self._key(key))
             if blob is None:
                 return 0
-            _, size = self.ENTRY.unpack(blob)
+            _, size, _ = self.ENTRY.unpack(blob)
             if t.size_is_deleted(size):
                 return 0
-            self._kv.delete(self._key(key))
+            self._idx_entries += 1
+            self._kv.put(self._key(key),
+                         self.ENTRY.pack(0, t.TOMBSTONE_SIZE,
+                                         self._idx_entries))
+            self._live_count -= 1
             self.deleted_count += 1
             self.deleted_size += size
             self._append_entry(key, marker_offset, t.TOMBSTONE_SIZE)
@@ -310,15 +358,17 @@ class KvNeedleMap(NeedleMap):
         shutil.rmtree(self.index_path + ".nmkv", ignore_errors=True)
 
     def __len__(self) -> int:
-        return len(self._kv)
+        return self._live_count
 
     def keys(self):
-        return [struct.unpack(">Q", k)[0] for k, _ in self._kv.scan(b"")]
+        return [k for k, _ in self.items()]
 
     def items(self):
-        for k, v in self._kv.scan(b""):
-            offset, size = self.ENTRY.unpack(v)
-            yield struct.unpack(">Q", k)[0], (offset, size)
+        for k, v in self._kv.scan(self._PFX):
+            offset, size, _ = self.ENTRY.unpack(v)
+            if not t.size_is_deleted(size):
+                yield struct.unpack(">Q", k[1:])[0], \
+                    NeedleValue(offset=offset, size=size)
 
 
 def make_needle_map(index_path: Optional[str],

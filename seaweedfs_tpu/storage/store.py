@@ -18,10 +18,11 @@ from seaweedfs_tpu.storage.volume import Volume, VolumeError
 
 class Store:
     def __init__(self, directories: List[str], max_volume_counts: Optional[List[int]] = None,
-                 ip: str = "", port: int = 0, public_url: str = ""):
+                 ip: str = "", port: int = 0, public_url: str = "",
+                 needle_map_kind: str = "memory"):
         if max_volume_counts is None:
             max_volume_counts = [8] * len(directories)
-        self.locations = [DiskLocation(d, c)
+        self.locations = [DiskLocation(d, c, needle_map_kind=needle_map_kind)
                           for d, c in zip(directories, max_volume_counts)]
         self.ip = ip
         self.port = port

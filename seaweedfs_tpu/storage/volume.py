@@ -34,7 +34,7 @@ from seaweedfs_tpu.storage.backend import (
 from seaweedfs_tpu.storage.needle import (
     Needle, NeedleError, CookieMismatch, actual_size, VERSION3,
 )
-from seaweedfs_tpu.storage.needle_map import NeedleMap
+from seaweedfs_tpu.storage.needle_map import NeedleMap, make_needle_map
 from seaweedfs_tpu.storage.superblock import SuperBlock, ReplicaPlacement, TTL
 from seaweedfs_tpu.storage import idx as idx_codec
 
@@ -156,10 +156,12 @@ class Volume:
                  replica_placement: ReplicaPlacement = ReplicaPlacement(),
                  ttl: TTL = TTL.empty(),
                  create_if_missing: bool = True,
-                 async_write: bool = True):
+                 async_write: bool = True,
+                 needle_map_kind: str = "memory"):
         self.dir = dirname
         self.collection = collection
         self.id = vid
+        self.needle_map_kind = needle_map_kind
         self.version = VERSION3
         self.read_only = False
         self.last_append_at_ns = 0
@@ -187,7 +189,7 @@ class Volume:
             self._dat: BackendStorageFile = DiskFile(self.dat_path,
                                                      create=True)
             self._dat.write_at(self.super_block.to_bytes(), 0)
-            self.nm = NeedleMap(self.idx_path)
+            self.nm = make_needle_map(self.idx_path, self.needle_map_kind)
 
     # -- naming --------------------------------------------------------------
 
@@ -228,7 +230,7 @@ class Volume:
             raise VolumeError(f"{self.dat_path}: truncated superblock")
         self.super_block = SuperBlock.from_bytes(header)
         self.version = self.super_block.version
-        self.nm = NeedleMap(self.idx_path)
+        self.nm = make_needle_map(self.idx_path, self.needle_map_kind)
         if not self._dat.is_remote:
             self._check_and_fix_integrity()
         self._restore_last_append_ns()
@@ -593,7 +595,7 @@ class Volume:
     def destroy(self) -> None:
         from seaweedfs_tpu.storage.backend import tier_info_path
         self.close()
-        for p in (self.dat_path, self.idx_path,
-                  tier_info_path(self.file_name())):
+        self.nm.destroy()  # removes .idx (and the .nmkv dir for kv kind)
+        for p in (self.dat_path, tier_info_path(self.file_name())):
             if os.path.exists(p):
                 os.remove(p)

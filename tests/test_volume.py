@@ -13,9 +13,9 @@ from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.volume import Volume, VolumeError
 
 
-@pytest.fixture
-def vol(tmp_path):
-    v = Volume(str(tmp_path), "", 1)
+@pytest.fixture(params=["memory", "kv"])
+def vol(tmp_path, request):
+    v = Volume(str(tmp_path), "", 1, needle_map_kind=request.param)
     yield v
     v.close()
 
@@ -53,14 +53,16 @@ def test_delete_then_read_fails(vol):
     assert vol.delete_needle(Needle(id=1, cookie=0x11)) == 0
 
 
-def test_reload_replays_index(tmp_path):
-    v = Volume(str(tmp_path), "", 2)
+@pytest.mark.parametrize("kind", ["memory", "kv"])
+def test_reload_replays_index(tmp_path, kind):
+    v = Volume(str(tmp_path), "", 2, needle_map_kind=kind)
     for i in range(10):
         v.write_needle(Needle(id=i + 1, cookie=7, data=f"data{i}".encode()))
     v.delete_needle(Needle(id=3, cookie=7))
     v.close()
 
-    v2 = Volume(str(tmp_path), "", 2, create_if_missing=False)
+    v2 = Volume(str(tmp_path), "", 2, create_if_missing=False,
+                needle_map_kind=kind)
     assert v2.file_count == 9
     assert v2.read_needle(Needle(id=5, cookie=7)).data == b"data4"
     with pytest.raises(NeedleError):
@@ -201,6 +203,152 @@ def test_store_readonly(tmp_path):
     s.mark_volume_writable(1)
     s.write_needle(1, Needle(id=1, cookie=1, data=b"yes"))
     s.close()
+
+
+# -- persistent (LogKV) needle map -------------------------------------------
+
+
+def test_kv_needle_map_metrics_and_reopen(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import KvNeedleMap
+
+    p = str(tmp_path / "k.idx")
+    nm = KvNeedleMap(p)
+    nm.put(1, 8, 100)
+    nm.put(2, 128, 200)
+    nm.put(1, 256, 150)  # overwrite
+    assert nm.file_count == 3
+    assert nm.deleted_count == 1
+    assert nm.deleted_size == 100
+    assert len(nm) == 2
+    nm.delete(2, 512)
+    assert nm.get(2) is None
+    assert sorted(nm.keys()) == [1]
+    nm.close()
+    nm2 = KvNeedleMap(p)
+    assert nm2.get(1).size == 150
+    assert nm2.get(2) is None
+    assert nm2.max_key == 2
+    assert nm2.file_count == 3
+    assert nm2.deleted_count == 2
+    assert nm2.deleted_size == 300
+    assert len(nm2) == 1
+    assert [(k, v.offset, v.size) for k, v in nm2.items()] == [(1, 256, 150)]
+    nm2.close()
+
+
+def test_kv_needle_map_replays_idx_tail_on_lagging_kv(tmp_path):
+    """Crash with the KV lagging the durable .idx (ADVICE r2: the old
+    heuristic only repaired an EMPTY kv): the missing tail must be
+    replayed so acked writes never 404 after recovery."""
+    from seaweedfs_tpu.storage.needle_map import KvNeedleMap
+
+    p = str(tmp_path / "k.idx")
+    nm = KvNeedleMap(p)
+    nm.put(1, 8, 100)
+    nm.put(2, 128, 200)
+    nm.close()
+    # simulate acked entries that reached the .idx but whose KV puts
+    # were lost in a crash: append straight to the .idx
+    with open(p, "ab") as f:
+        f.write(idx_codec.entry_to_bytes(3, 512, 300))
+        f.write(idx_codec.entry_to_bytes(1, 1024, t.TOMBSTONE_SIZE))
+    nm2 = KvNeedleMap(p)
+    assert nm2.get(3).offset == 512        # replayed put
+    assert nm2.get(1) is None              # replayed tombstone
+    assert nm2.get(2).size == 200          # untouched prefix intact
+    assert nm2.file_count == 3
+    assert nm2.deleted_count == 1
+    assert len(nm2) == 2
+    nm2.close()
+    # reconciliation is durable: a third open needs no replay
+    nm3 = KvNeedleMap(p)
+    assert nm3.get(3).offset == 512 and nm3.get(1) is None
+    nm3.close()
+
+
+def test_kv_needle_map_rebuilds_when_kv_ahead_of_idx(tmp_path):
+    """Crash before a buffered .idx batch hit disk while the KV's own
+    log did: the .idx is canon, so phantom KV entries must be wiped."""
+    from seaweedfs_tpu.storage.needle_map import KvNeedleMap
+
+    p = str(tmp_path / "k.idx")
+    nm = KvNeedleMap(p)
+    nm.put(1, 8, 100)
+    nm.put(2, 128, 200)
+    nm.put(3, 512, 300)
+    nm.sync()
+    nm.close()
+    # lose the last .idx entry (buffered batch never flushed)
+    with open(p, "r+b") as f:
+        f.truncate(2 * t.NEEDLE_MAP_ENTRY_SIZE)
+    nm2 = KvNeedleMap(p)
+    assert nm2.get(3) is None              # phantom gone
+    assert nm2.get(1).size == 100
+    assert nm2.get(2).size == 200
+    assert nm2.file_count == 2
+    assert len(nm2) == 2
+    nm2.close()
+
+
+def test_kv_needle_map_wipes_phantom_kv_without_idx(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import KvNeedleMap
+
+    p = str(tmp_path / "k.idx")
+    nm = KvNeedleMap(p)
+    nm.put(1, 8, 100)
+    nm.sync()
+    nm.close()
+    os.remove(p)
+    nm2 = KvNeedleMap(p)
+    assert nm2.get(1) is None
+    assert len(nm2) == 0 and nm2.file_count == 0
+    nm2.close()
+
+
+def test_kv_kind_delete_heavy_reload(tmp_path):
+    """Delete-heavy volume over the kv kind: reopen reflects only live
+    needles (the O(live)-reopen use case the kind exists for)."""
+    v = Volume(str(tmp_path), "", 11, needle_map_kind="kv")
+    for i in range(60):
+        v.write_needle(Needle(id=i + 1, cookie=5, data=b"z" * 64))
+    for i in range(50):
+        v.delete_needle(Needle(id=i + 1, cookie=5))
+    v.close()
+    v2 = Volume(str(tmp_path), "", 11, create_if_missing=False,
+                needle_map_kind="kv")
+    assert len(v2.nm) == 10
+    assert v2.file_count == 10           # live needles
+    assert v2.nm.file_count == 60        # total puts in history
+    assert v2.nm.deleted_count == 50
+    assert v2.read_needle(Needle(id=55, cookie=5)).data == b"z" * 64
+    with pytest.raises(NeedleError):
+        v2.read_needle(Needle(id=5, cookie=5))
+    v2.close()
+
+
+def test_kv_kind_destroy_removes_kv_dir(tmp_path):
+    v = Volume(str(tmp_path), "", 12, needle_map_kind="kv")
+    v.write_needle(Needle(id=1, cookie=1, data=b"bye"))
+    kv_dir = v.idx_path + ".nmkv"
+    assert os.path.isdir(kv_dir)
+    v.destroy()
+    assert not os.path.exists(kv_dir)
+    assert not os.path.exists(v.idx_path)
+    assert not os.path.exists(v.dat_path)
+
+
+def test_make_needle_map_kinds(tmp_path):
+    from seaweedfs_tpu.storage.needle_map import (
+        KvNeedleMap, make_needle_map)
+
+    assert isinstance(make_needle_map(None, "memory"), NeedleMap)
+    kv = make_needle_map(str(tmp_path / "a.idx"), "kv")
+    assert isinstance(kv, KvNeedleMap)
+    kv.close()
+    with pytest.raises(ValueError):
+        make_needle_map(None, "kv")
+    with pytest.raises(ValueError):
+        make_needle_map(None, "bogus")
 
 
 # -- group-commit write path --------------------------------------------------
