@@ -1,10 +1,12 @@
-"""Headline benchmark: EC encode throughput, TPU vs CPU baseline.
+"""Headline benchmark: EC encode+rebuild throughput, TPU vs CPU baseline.
 
-Measures the RS(10,4) GF(2^8) encode kernel — the compute behind
-`ec.encode` (reference: /root/reference
-weed/storage/erasure_coding/ec_encoder.go:162-192, whose kernel is
-klauspost/reedsolomon's SIMD encoder; our CPU stand-in is the C++ AVX2
-library in seaweedfs_tpu/native).
+Measures BOTH halves of the RS(10,4) GF(2^8) north star — encode (the
+compute behind `ec.encode`, reference /root/reference
+weed/storage/erasure_coding/ec_encoder.go:162-192) and rebuild (the
+Cauchy-inverse map behind `ec.rebuild`/RebuildEcFiles,
+ec_encoder.go:233-287). Both are the same bit-matmul kernel with
+different matrices; the CPU stand-in for each is the C++ AVX2 library
+in seaweedfs_tpu/native (klauspost/reedsolomon's role).
 
 On-device timing discipline: one dispatch per timed repetition, with
 ITERS encodes chained inside a single jit via lax.fori_loop. Two
@@ -30,8 +32,11 @@ round-trip is amortized by chaining ITERS encodes per dispatch (~2.5 s
 of device work per fetch).
 
 Prints ONE json line:
-  {"metric": "ec_encode_gbps", "value": <TPU GB/s>, "unit": "GB/s",
-   "vs_baseline": <ratio vs native CPU single-thread>}
+  {"metric": "ec_encode_rebuild_gbps", "value": <TPU GB/s>, "unit": "GB/s",
+   "vs_baseline": <ratio vs native CPU single-thread>, ...}
+where value is the combined encode-then-rebuild throughput (harmonic
+mean of the two phase throughputs: GB processed per second when every
+byte is encoded once and rebuilt once), plus per-phase fields.
 """
 
 import json
@@ -72,25 +77,34 @@ def _hbm_roofline(devices) -> float:
     return max(_HBM_GBPS.values())
 
 
-def tpu_gbps() -> float:
+# Rebuild scenario: the worst case — data shards 0-3 lost, survivors
+# are shards 4..13; the decode map is the Cauchy inverse restricted to
+# the lost rows — a [4, 10] GF matrix, the same kernel shape as encode.
+REBUILD_PRESENT = tuple(range(4, 14))
+REBUILD_WANTED = (0, 1, 2, 3)
+
+
+def tpu_phase_gbps(matrix: np.ndarray) -> float:
+    """Chained on-device throughput of one [4, 10] GF(2^8) linear map
+    (encode or rebuild — both phases are this kernel)."""
     import jax
     import jax.numpy as jnp
-    from seaweedfs_tpu.ops.rs_code import PARITY_SHARDS
-    from seaweedfs_tpu.ops.rs_kernel import gf_linear, parity_m2_bits
+    from seaweedfs_tpu.ops.rs_kernel import gf_linear, m2_bits
 
-    m2 = parity_m2_bits()
+    m2 = m2_bits(matrix)
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.integers(
         0, 256, size=(DATA_SHARDS, LANES), dtype=np.uint8))
-    reps = DATA_SHARDS // PARITY_SHARDS + 1      # 4,4,2 rows -> 10
+    n_out = matrix.shape[0]
+    reps = DATA_SHARDS // n_out + 1              # 4,4,2 rows -> 10
 
     @jax.jit
     def run(m2, data):
         def body(i, d):
-            parity = gf_linear(m2, d)            # [4, N] — full encode
+            out = gf_linear(m2, d)               # [4, N] — full map
             fold = jnp.concatenate(
-                [parity] * reps, axis=0)[:DATA_SHARDS]
-            return d ^ fold                      # full-parity dependence
+                [out] * reps, axis=0)[:DATA_SHARDS]
+            return d ^ fold                      # full-output dependence
         d = jax.lax.fori_loop(0, ITERS, body, data)
         return jnp.sum(d, dtype=jnp.uint32)      # every byte live
 
@@ -111,7 +125,30 @@ def tpu_gbps() -> float:
     return gbps
 
 
-def cpu_gbps() -> tuple[float, str]:
+def _matrices():
+    """(encode parity rows, rebuild decode map), both [4, 10] GF(2^8)."""
+    from seaweedfs_tpu.ops.rs_code import ReedSolomon, coding_matrix
+    rs = ReedSolomon()
+    enc = np.asarray(coding_matrix())[DATA_SHARDS:]
+    reb = np.asarray(rs.decode_matrix(REBUILD_PRESENT, REBUILD_WANTED))
+    return enc, reb
+
+
+def cpu_phase_gbps(matrix: np.ndarray, backend: str) -> float:
+    from seaweedfs_tpu.ops.rs_code import ReedSolomon
+    rs = ReedSolomon(backend=backend)
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(DATA_SHARDS, CPU_LANES), dtype=np.uint8)
+    rs._apply(matrix, data)  # warm (table setup, page-in)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rs._apply(matrix, data)
+        best = min(best, time.perf_counter() - t0)
+    return DATA_SHARDS * CPU_LANES / best / 1e9
+
+
+def _cpu_backend() -> str:
     from seaweedfs_tpu.native import rs_native
     if not rs_native.available():
         r = subprocess.run(
@@ -119,30 +156,35 @@ def cpu_gbps() -> tuple[float, str]:
             capture_output=True)
         if r.returncode != 0:
             print(r.stderr.decode(errors="replace"), file=sys.stderr)
-    from seaweedfs_tpu.ops.rs_code import ReedSolomon
-    backend = "native" if rs_native.available() else "numpy"
-    rs = ReedSolomon(backend=backend)
-    rng = np.random.default_rng(1)
-    data = rng.integers(0, 256, size=(DATA_SHARDS, CPU_LANES), dtype=np.uint8)
-    rs.encode(data)  # warm (table setup, page-in)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        rs.encode(data)
-        best = min(best, time.perf_counter() - t0)
-    return DATA_SHARDS * CPU_LANES / best / 1e9, backend
+    return "native" if rs_native.available() else "numpy"
+
+
+def _combined(encode_gbps: float, rebuild_gbps: float) -> float:
+    """GB/s when every byte is encoded once and rebuilt once (harmonic
+    mean): total work 2B over time B/enc + B/reb."""
+    return 2.0 / (1.0 / encode_gbps + 1.0 / rebuild_gbps)
 
 
 def main() -> None:
-    cpu, cpu_backend = cpu_gbps()
-    tpu = tpu_gbps()
+    backend = _cpu_backend()
+    enc_m, reb_m = _matrices()
+    cpu_enc = cpu_phase_gbps(enc_m, backend)
+    cpu_reb = cpu_phase_gbps(reb_m, backend)
+    tpu_enc = tpu_phase_gbps(enc_m)
+    tpu_reb = tpu_phase_gbps(reb_m)
+    tpu = _combined(tpu_enc, tpu_reb)
+    cpu = _combined(cpu_enc, cpu_reb)
     print(json.dumps({
-        "metric": "ec_encode_gbps",
+        "metric": "ec_encode_rebuild_gbps",
         "value": round(tpu, 3),
         "unit": "GB/s",
         "vs_baseline": round(tpu / cpu, 3),
-        "baseline_backend": cpu_backend,
+        "encode_gbps": round(tpu_enc, 3),
+        "rebuild_gbps": round(tpu_reb, 3),
+        "baseline_backend": backend,
         "baseline_gbps": round(cpu, 3),
+        "baseline_encode_gbps": round(cpu_enc, 3),
+        "baseline_rebuild_gbps": round(cpu_reb, 3),
     }))
 
 

@@ -230,15 +230,23 @@ def rebuild_ec_files(base_name: str, backend: str = "auto",
     shard_size = os.path.getsize(shard_file_name(base_name, present[0]))
     ins = {i: open(shard_file_name(base_name, i), "rb") for i in present}
     outs = {i: open(shard_file_name(base_name, i), "wb") for i in missing}
+    pipe = _EncodePipeline()
     try:
         for c in range(0, shard_size, chunk):
             clen = min(chunk, shard_size - c)
             src = np.empty((len(present[:DATA_SHARDS]), clen), dtype=np.uint8)
             for row, i in enumerate(present[:DATA_SHARDS]):
                 src[row] = _read_padded(ins[i], c, clen)
-            out = rs.reconstruct_some(present, missing, src)
-            for row, i in enumerate(missing):
-                outs[i].write(out[row].tobytes())
+            handle = rs.reconstruct_some_async(present, missing, src)
+
+            def write_rebuilt(out, outs=outs):
+                for row, i in enumerate(missing):
+                    outs[i].write(out[row].tobytes())
+
+            # retire in FIFO order: while the device reconstructs chunk
+            # i, the host reads chunk i+1 (same overlap as encode)
+            pipe.submit(handle, write_rebuilt)
+        pipe.drain()
     finally:
         for f in ins.values():
             f.close()

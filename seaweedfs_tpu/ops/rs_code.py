@@ -144,6 +144,15 @@ class ReedSolomon:
         parity = self.encode(shards[..., : self.data_shards, :])
         return bool(np.array_equal(parity, shards[..., self.data_shards:, :]))
 
+    def decode_matrix(self, present: Sequence[int],
+                      wanted: Sequence[int]) -> np.ndarray:
+        """Public accessor for the GF(2^8) map shards[present[:D]] ->
+        shards[wanted] (read-only). The rebuild benchmark feeds this to
+        the TPU kernel directly — rebuild is the SAME bit-matmul as
+        encode, just a Cauchy-inverse-derived matrix."""
+        return self._decode_matrix(tuple(present)[: self.data_shards],
+                                   tuple(wanted))
+
     def reconstruct_some(self, present: Sequence[int], wanted: Sequence[int],
                          shard_data: np.ndarray) -> np.ndarray:
         """Compute shards `wanted` from shards `present`.
@@ -151,10 +160,25 @@ class ReedSolomon:
         shard_data: [..., len(present), N] uint8, rows ordered like `present`.
         Uses only the first `data_shards` entries of `present`.
         """
+        return self.reconstruct_some_async(present, wanted,
+                                           shard_data).result()
+
+    def reconstruct_some_async(self, present: Sequence[int],
+                               wanted: Sequence[int],
+                               shard_data: np.ndarray):
+        """Pipelined reconstruct_some: returns a handle with .result().
+
+        Same contract as encode_async — on the jax backend the dispatch
+        is in flight while the caller overlaps host IO (the rebuild
+        pipeline in ec/encoder.py rides this)."""
         present = tuple(present)
         m = self._decode_matrix(present[: self.data_shards], tuple(wanted))
         shard_data = np.asarray(shard_data, dtype=np.uint8)
-        return self._apply(m, shard_data[..., : self.data_shards, :])
+        if self.backend == "jax":
+            from seaweedfs_tpu.ops import rs_kernel
+            return rs_kernel.apply_matrix_async(
+                m, shard_data[..., : self.data_shards, :])
+        return _Resolved(self._apply(m, shard_data[..., : self.data_shards, :]))
 
     def reconstruct(self, shards: list[Optional[np.ndarray]],
                     data_only: bool = False) -> list[np.ndarray]:

@@ -242,34 +242,6 @@ class KvNeedleMap(NeedleMap):
     def _key(cls, key: int) -> bytes:
         return cls._PFX + struct.pack(">Q", key)
 
-    def _scan_applied(self) -> int:
-        """High-water mark: how many .idx entries the KV reflects."""
-        applied = 0
-        for _, v in self._kv.scan(self._PFX):
-            seq = self.ENTRY.unpack(v)[2]
-            if seq > applied:
-                applied = seq
-        return applied
-
-    def _replay_op(self, i: int, key: int, offset: int, size: int) -> None:
-        self._kv.put(self._key(key), self.ENTRY.pack(offset, size, i + 1))
-
-    def _reconcile(self, arr, sizes) -> None:
-        """Bring the KV in line with the canonical .idx after a crash."""
-        n_idx = len(arr)
-        applied = self._scan_applied()
-        if applied > n_idx:
-            # KV outran the durable .idx (crash before the buffered
-            # .idx batch hit disk). The .idx is canon: rebuild.
-            self._kv.delete_prefix(b"")
-            applied = 0
-        for i in range(applied, n_idx):
-            size = int(sizes[i])
-            self._replay_op(i, int(arr["key"][i]),
-                            int(arr["offset"][i]) if size >= 0 else 0,
-                            size if size >= 0 else t.TOMBSTONE_SIZE)
-        self._idx_entries = n_idx
-
     def _load_stats(self, path: str) -> None:
         arr = read_index_array(path)
         if arr is None or not len(arr):
@@ -278,18 +250,47 @@ class KvNeedleMap(NeedleMap):
                 self._kv.delete_prefix(b"")
             return
         sizes = arr["size"].astype(np.int64)
-        self._reconcile(arr, sizes)
+        # ONE scan over the KV: the reconciliation high-water mark
+        # (max embedded seq) and the live stats come from the same pass
+        applied = live = live_size = 0
+        for _, v in self._kv.scan(self._PFX):
+            _, size, seq = self.ENTRY.unpack(v)
+            if seq > applied:
+                applied = seq
+            if not t.size_is_deleted(size):
+                live += 1
+                live_size += size
+        n_idx = len(arr)
+        if applied > n_idx:
+            # KV outran the durable .idx (crash before the buffered
+            # .idx batch hit disk). The .idx is canon: rebuild.
+            self._kv.delete_prefix(b"")
+            applied = live = live_size = 0
+        for i in range(applied, n_idx):
+            # replay the missing tail (idempotent, in order), adjusting
+            # the live stats incrementally — gets only touch tail keys
+            size = int(sizes[i])
+            key = int(arr["key"][i])
+            prev = self._kv.get(self._key(key))
+            if prev is not None:
+                _, psize, _ = self.ENTRY.unpack(prev)
+                if not t.size_is_deleted(psize):
+                    live -= 1
+                    live_size -= psize
+            if size >= 0:
+                self._kv.put(self._key(key),
+                             self.ENTRY.pack(int(arr["offset"][i]),
+                                             size, i + 1))
+                live += 1
+                live_size += size
+            else:
+                self._kv.put(self._key(key),
+                             self.ENTRY.pack(0, t.TOMBSTONE_SIZE, i + 1))
+        self._idx_entries = n_idx
         puts = sizes >= 0
         self.file_count = int(puts.sum())
         self.content_size = int(sizes[puts].sum())
         self.max_key = int(arr["key"].max())
-        live = 0
-        live_size = 0
-        for _, v in self._kv.scan(self._PFX):
-            _, size, _ = self.ENTRY.unpack(v)
-            if not t.size_is_deleted(size):
-                live += 1
-                live_size += size
         self._live_count = live
         self.deleted_count = self.file_count - live
         self.deleted_size = self.content_size - live_size
