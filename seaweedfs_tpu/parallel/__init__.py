@@ -22,8 +22,11 @@ host gRPC).
 from seaweedfs_tpu.parallel.mesh import (
     make_mesh,
     sharded_encode,
+    sharded_write_ec_files,
     ec_pipeline_step,
     rotate_shards,
+    volume_shard_matrix,
 )
 
-__all__ = ["make_mesh", "sharded_encode", "ec_pipeline_step", "rotate_shards"]
+__all__ = ["make_mesh", "sharded_encode", "sharded_write_ec_files",
+           "ec_pipeline_step", "rotate_shards", "volume_shard_matrix"]

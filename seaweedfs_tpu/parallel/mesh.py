@@ -148,3 +148,80 @@ def _decode_bits(drop: Tuple[int, int]):
     rs = ReedSolomon()
     present = tuple(i for i in range(TOTAL_SHARDS) if i not in drop)
     return m2_bits(rs._decode_matrix(present[:DATA_SHARDS], drop))
+
+
+# -- many-volumes-over-the-mesh encode (BASELINE config 4 shape) -------------
+
+def volume_shard_matrix(dat_path: str, small_block: int) -> np.ndarray:
+    """A volume's .dat as its shard-content matrix [D, n_rows*small_block].
+
+    Row r of the .dat is dat[r*D*sb : (r+1)*D*sb]; shard i's slice of
+    that row is its i-th sb-sized block (reference ec_encoder.go row
+    striping). Stacking rows per shard gives exactly the bytes of
+    .ec00..ec09 — a pure reshape, no compute."""
+    raw = np.fromfile(dat_path, dtype=np.uint8)
+    row_bytes = DATA_SHARDS * small_block
+    n_rows = -(-len(raw) // row_bytes)   # 0 rows for an empty .dat
+    padded = np.zeros(n_rows * row_bytes, dtype=np.uint8)
+    padded[: len(raw)] = raw
+    rows = padded.reshape(n_rows, DATA_SHARDS, small_block)
+    return np.ascontiguousarray(
+        np.moveaxis(rows, 0, 1)).reshape(DATA_SHARDS, n_rows * small_block)
+
+
+def sharded_write_ec_files(mesh: Mesh, base_names: Sequence[str],
+                           small_block: int = 1 << 20) -> None:
+    """Encode MANY volumes in one mesh-sharded dispatch and write each
+    volume's .ec00-.ec13.
+
+    The BASELINE config-4 shape: the volume batch rides the dp axis,
+    each volume's byte lanes ride sp — the cluster-wide `ec.encode`
+    cron that the reference fans out over gRPC
+    (shell/command_ec_encode.go:92-160) becomes one XLA program over
+    the mesh. Volumes under 10*large_block use uniform small rows, so
+    this matches write_ec_files' on-disk layout byte-for-byte.
+    """
+    import os as _os
+
+    from seaweedfs_tpu.ec.encoder import (
+        LARGE_BLOCK_SIZE, TOTAL_SHARDS as _TS, shard_file_name)
+
+    if not base_names:
+        return
+    for b in base_names:
+        if _os.path.getsize(b + ".dat") > DATA_SHARDS * LARGE_BLOCK_SIZE:
+            raise ValueError(
+                f"{b}.dat exceeds {DATA_SHARDS}x{LARGE_BLOCK_SIZE} bytes: "
+                "large-row striping required — use write_ec_files")
+    sizes = []
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    # first pass: write the data shards straight from each volume's
+    # matrix (systematic code) and record sizes, so only the single
+    # padded batch array is ever resident alongside one volume's matrix
+    max_size = 0
+    for base in base_names:
+        m = volume_shard_matrix(base + ".dat", small_block)
+        sizes.append(m.shape[1])
+        max_size = max(max_size, m.shape[1])
+        for i in range(DATA_SHARDS):
+            with open(shard_file_name(base, i), "wb") as f:
+                f.write(m[i].tobytes())
+    if max_size == 0:                            # all volumes empty
+        for base in base_names:
+            for i in range(DATA_SHARDS, _TS):
+                open(shard_file_name(base, i), "wb").close()
+        return
+    n_lanes = -(-max_size // sp) * sp            # pad lanes to sp multiple
+    n_vols = -(-len(base_names) // dp) * dp      # pad batch to dp multiple
+    data = np.zeros((n_vols, DATA_SHARDS, n_lanes), dtype=np.uint8)
+    for v, base in enumerate(base_names):
+        for i in range(DATA_SHARDS):
+            with open(shard_file_name(base, i), "rb") as f:
+                data[v, i, : sizes[v]] = np.frombuffer(
+                    f.read(), dtype=np.uint8)
+    parity = np.asarray(sharded_encode(mesh, data))
+    del data
+    for v, base in enumerate(base_names):
+        for p in range(parity.shape[1]):
+            with open(shard_file_name(base, DATA_SHARDS + p), "wb") as f:
+                f.write(parity[v, p, : sizes[v]].tobytes())

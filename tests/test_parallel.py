@@ -1,5 +1,7 @@
 """Mesh-sharded EC pipeline on the virtual 8-device CPU mesh."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -41,6 +43,79 @@ def test_pipeline_step_rebuilds_exactly(mesh):
     assert int(mismatches) == 0
     want = ReedSolomon(backend="numpy").encode(data)
     np.testing.assert_array_equal(np.asarray(parity), want)
+
+
+def _host_rs():
+    """Independent host-side comparator: native AVX2 if built, numpy
+    otherwise — either way a non-jax implementation of the same code."""
+    return ReedSolomon(backend="auto")
+
+
+def test_pipeline_step_at_64mb_per_device(mesh):
+    """Encode + worst-case rebuild at REAL size: >=64MB per device slab
+    (round-2 verdict: layout/halo bugs hide at sizes where one tile
+    holds everything). Byte-compared against the host backend."""
+    rng = np.random.default_rng(7)
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+    b = dp
+    lanes_per_dev = 6_800_000            # (b/dp)*10*lanes >= 64MB/device
+    n = sp * lanes_per_dev
+    data = rng.integers(0, 256, size=(b, DATA_SHARDS, n), dtype=np.uint8)
+    per_device = (b // dp) * DATA_SHARDS * (n // sp)
+    assert per_device >= 64 << 20
+    parity, rebuilt, mismatches = ec_pipeline_step(mesh, data, drop=(0, 13))
+    assert int(mismatches) == 0
+    want = _host_rs().encode(data)
+    np.testing.assert_array_equal(np.asarray(parity), want)
+    # the rebuilt rows must equal the original data/parity rows exactly
+    np.testing.assert_array_equal(np.asarray(rebuilt)[:, 0, :], data[:, 0, :])
+    np.testing.assert_array_equal(np.asarray(rebuilt)[:, 1, :], want[:, 3, :])
+
+
+def test_sharded_write_ec_files_over_volumes(mesh, tmp_path):
+    """Many volumes encoded in ONE mesh dispatch (BASELINE config-4
+    shape) must produce byte-identical .ecNN files to the per-volume
+    host write_ec_files path — including odd sizes that exercise row
+    padding and the batch/lane mesh padding."""
+    from seaweedfs_tpu.ec.encoder import shard_file_name, write_ec_files
+    from seaweedfs_tpu.parallel import sharded_write_ec_files
+
+    small = 64 << 10  # 64KB rows keep the fixture fast but multi-row
+    rng = np.random.default_rng(11)
+    sizes = [3 * 640 * 1024 + 13, 640 * 1024, 2 * 640 * 1024 + 1,
+             640 * 1024 - 7, 5 * 640 * 1024, 640 * 1024 + small,
+             7 * 640 * 1024 + small // 2,
+             0]  # 8 volumes incl. an EMPTY one (must match host: 0-byte shards)
+    bases = []
+    for v, size in enumerate(sizes):
+        base = str(tmp_path / f"{v + 1}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        bases.append(base)
+
+    sharded_write_ec_files(mesh, bases, small_block=small)
+    for v, base in enumerate(bases):
+        ref_base = str(tmp_path / f"ref{v + 1}")
+        os.link(base + ".dat", ref_base + ".dat")
+        write_ec_files(ref_base, backend="auto", small_block=small)
+        for i in range(14):
+            with open(shard_file_name(base, i), "rb") as f:
+                got = f.read()
+            with open(shard_file_name(ref_base, i), "rb") as f:
+                want = f.read()
+            assert got == want, f"volume {v + 1} shard {i} diverged"
+
+
+def test_sharded_write_ec_files_edge_cases(mesh, tmp_path):
+    from seaweedfs_tpu.ec.encoder import LARGE_BLOCK_SIZE
+    from seaweedfs_tpu.parallel import sharded_write_ec_files
+
+    sharded_write_ec_files(mesh, [])  # no volumes: no-op
+    big = str(tmp_path / "big")
+    with open(big + ".dat", "wb") as f:  # sparse: size without bytes
+        f.truncate(10 * LARGE_BLOCK_SIZE + 1)
+    with pytest.raises(ValueError, match="large-row"):
+        sharded_write_ec_files(mesh, [big])
 
 
 def test_rotate_shards_permutes_batch(mesh):
