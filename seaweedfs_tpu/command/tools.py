@@ -29,11 +29,13 @@ def run_shell(args) -> int:
     setup_client_tls()
     p = argparse.ArgumentParser(prog="shell")
     p.add_argument("-master", default="127.0.0.1:9333")
+    p.add_argument("-filer", default="",
+                   help="filer host:port enabling the fs.* commands")
     p.add_argument("command", nargs="*",
                    help="one-shot command (omit for a REPL)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.shell import CommandError, Shell
-    sh = Shell(opts.master)
+    sh = Shell(opts.master, filer_url=opts.filer)
     if opts.command:
         try:
             print(sh.run_command(" ".join(opts.command)), end="")

@@ -152,6 +152,11 @@ def _decode_bits(drop: Tuple[int, int]):
 
 # -- many-volumes-over-the-mesh encode (BASELINE config 4 shape) -------------
 
+# Lane window per sharded dispatch: bounds host memory at
+# dp * DATA_SHARDS * _WINDOW_LANES bytes and keeps the number of
+# distinct XLA shapes small (full windows share one compile).
+_WINDOW_LANES = 64 << 20
+
 def volume_shard_matrix(dat_path: str, small_block: int) -> np.ndarray:
     """A volume's .dat as its shard-content matrix [D, n_rows*small_block].
 
@@ -188,40 +193,62 @@ def sharded_write_ec_files(mesh: Mesh, base_names: Sequence[str],
 
     if not base_names:
         return
+    dat_sizes = {}
     for b in base_names:
-        if _os.path.getsize(b + ".dat") > DATA_SHARDS * LARGE_BLOCK_SIZE:
+        dat_sizes[b] = _os.path.getsize(b + ".dat")
+        if dat_sizes[b] > DATA_SHARDS * LARGE_BLOCK_SIZE:
             raise ValueError(
                 f"{b}.dat exceeds {DATA_SHARDS}x{LARGE_BLOCK_SIZE} bytes: "
                 "large-row striping required — use write_ec_files")
-    sizes = []
     dp, sp = mesh.shape["dp"], mesh.shape["sp"]
-    # first pass: write the data shards straight from each volume's
-    # matrix (systematic code) and record sizes, so only the single
-    # padded batch array is ever resident alongside one volume's matrix
-    max_size = 0
-    for base in base_names:
-        m = volume_shard_matrix(base + ".dat", small_block)
-        sizes.append(m.shape[1])
-        max_size = max(max_size, m.shape[1])
-        for i in range(DATA_SHARDS):
-            with open(shard_file_name(base, i), "wb") as f:
-                f.write(m[i].tobytes())
-    if max_size == 0:                            # all volumes empty
-        for base in base_names:
-            for i in range(DATA_SHARDS, _TS):
-                open(shard_file_name(base, i), "wb").close()
-        return
-    n_lanes = -(-max_size // sp) * sp            # pad lanes to sp multiple
-    n_vols = -(-len(base_names) // dp) * dp      # pad batch to dp multiple
-    data = np.zeros((n_vols, DATA_SHARDS, n_lanes), dtype=np.uint8)
-    for v, base in enumerate(base_names):
-        for i in range(DATA_SHARDS):
-            with open(shard_file_name(base, i), "rb") as f:
-                data[v, i, : sizes[v]] = np.frombuffer(
-                    f.read(), dtype=np.uint8)
-    parity = np.asarray(sharded_encode(mesh, data))
-    del data
-    for v, base in enumerate(base_names):
-        for p in range(parity.shape[1]):
-            with open(shard_file_name(base, DATA_SHARDS + p), "wb") as f:
-                f.write(parity[v, p, : sizes[v]].tobytes())
+    row_bytes = DATA_SHARDS * small_block
+    shard_rows = {b: -(-dat_sizes[b] // row_bytes) for b in base_names}
+    for base in base_names:                      # fresh output files
+        for i in range(_TS):
+            open(shard_file_name(base, i), "wb").close()
+
+    # Group volumes by size (desc) into dp-sized batches so lane
+    # padding only stretches to the largest volume IN THE GROUP, then
+    # stream each group through fixed lane WINDOWS: peak host memory is
+    # dp * 10 * window bytes regardless of volume or batch size (the
+    # review finding: a size-skewed batch must not allocate
+    # n_vols x max_volume bytes).
+    window_rows = max(1, _WINDOW_LANES // small_block)
+    ordered = sorted(base_names, key=lambda b: shard_rows[b], reverse=True)
+    for g0 in range(0, len(ordered), dp):
+        group = ordered[g0:g0 + dp]
+        max_rows = shard_rows[group[0]]
+        for w0 in range(0, max_rows, window_rows):
+            rows = min(window_rows, max_rows - w0)
+            lanes = -(-(rows * small_block) // sp) * sp
+            data = np.zeros((dp, DATA_SHARDS, lanes), dtype=np.uint8)
+            for v, base in enumerate(group):
+                v_rows = min(max(shard_rows[base] - w0, 0), rows)
+                if v_rows == 0:
+                    continue
+                # read rows [w0, w0+v_rows) straight from the .dat:
+                # one sequential read, reshaped to shard-major
+                start = w0 * row_bytes
+                want = v_rows * row_bytes
+                with open(base + ".dat", "rb") as f:
+                    f.seek(start)
+                    raw = f.read(min(want, max(dat_sizes[base] - start, 0)))
+                buf = np.zeros(want, dtype=np.uint8)
+                buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+                m = np.ascontiguousarray(np.moveaxis(
+                    buf.reshape(v_rows, DATA_SHARDS, small_block),
+                    0, 1)).reshape(DATA_SHARDS, v_rows * small_block)
+                data[v, :, : m.shape[1]] = m
+                for i in range(DATA_SHARDS):     # systematic data shards
+                    with open(shard_file_name(base, i), "ab") as f:
+                        f.write(m[i].tobytes())
+            parity = np.asarray(sharded_encode(mesh, data))
+            for v, base in enumerate(group):
+                v_lanes = min(max(shard_rows[base] - w0, 0),
+                              rows) * small_block
+                if v_lanes == 0:
+                    continue
+                for p in range(parity.shape[1]):
+                    with open(shard_file_name(base, DATA_SHARDS + p),
+                              "ab") as f:
+                        f.write(parity[v, p, : v_lanes].tobytes())

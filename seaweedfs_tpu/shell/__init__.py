@@ -29,6 +29,7 @@ def command(name: str, help_text: str = ""):
 
 # registration side effects
 from seaweedfs_tpu.shell import command_ec  # noqa: E402,F401
+from seaweedfs_tpu.shell import command_fs  # noqa: E402,F401
 from seaweedfs_tpu.shell import command_misc  # noqa: E402,F401
 from seaweedfs_tpu.shell import command_volume  # noqa: E402,F401
 
@@ -43,8 +44,8 @@ class CommandError(Exception):
 
 
 class Shell:
-    def __init__(self, master_url: str):
-        self.env = CommandEnv(master_url)
+    def __init__(self, master_url: str, filer_url: str = ""):
+        self.env = CommandEnv(master_url, filer_url=filer_url)
 
     def run_command(self, line: str) -> str:
         argv = shlex.split(line)

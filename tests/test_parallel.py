@@ -106,6 +106,36 @@ def test_sharded_write_ec_files_over_volumes(mesh, tmp_path):
             assert got == want, f"volume {v + 1} shard {i} diverged"
 
 
+def test_sharded_write_ec_files_windowed(mesh, tmp_path, monkeypatch):
+    """Size-skewed batch with a tiny lane window: grouping by size and
+    multi-window streaming must still be byte-identical to the host."""
+    from seaweedfs_tpu.ec.encoder import shard_file_name, write_ec_files
+    from seaweedfs_tpu.parallel import mesh as mesh_mod
+
+    small = 16 << 10
+    monkeypatch.setattr(mesh_mod, "_WINDOW_LANES", 2 * small)  # 2-row windows
+    rng = np.random.default_rng(3)
+    # one big volume among small ones: the skew case from the review
+    sizes = [9 * 160 * 1024 + 5, 160 * 1024, 17, 2 * 160 * 1024]
+    bases = []
+    for v, size in enumerate(sizes):
+        base = str(tmp_path / f"{v + 1}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        bases.append(base)
+    mesh_mod.sharded_write_ec_files(mesh, bases, small_block=small)
+    for v, base in enumerate(bases):
+        ref_base = str(tmp_path / f"ref{v + 1}")
+        os.link(base + ".dat", ref_base + ".dat")
+        write_ec_files(ref_base, backend="auto", small_block=small)
+        for i in range(14):
+            with open(shard_file_name(base, i), "rb") as f:
+                got = f.read()
+            with open(shard_file_name(ref_base, i), "rb") as f:
+                want = f.read()
+            assert got == want, f"volume {v + 1} shard {i} diverged"
+
+
 def test_sharded_write_ec_files_edge_cases(mesh, tmp_path):
     from seaweedfs_tpu.ec.encoder import LARGE_BLOCK_SIZE
     from seaweedfs_tpu.parallel import sharded_write_ec_files
