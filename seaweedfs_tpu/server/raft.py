@@ -11,11 +11,16 @@ role here:
   leader heartbeats (AppendEntries) over the master's own gRPC server,
   replicated to all peers in parallel so one hung peer cannot starve
   the live ones of heartbeats;
-- a persistent log + term/vote state under the master's -mdir
-  (reference: raft log dir = -mdir, command/master.go:118), compacted
-  into a state-machine snapshot once it exceeds LOG_CAP entries (the
-  reference snapshots the same way); followers that fall behind the
-  compacted base receive the snapshot piggybacked on AppendEntries;
+- persistent state under the master's -mdir (reference: raft log dir =
+  -mdir, command/master.go:118), split per Raft's durability rules:
+  `raft.meta.json` (term + vote, fsync'd BEFORE any vote/term reply
+  leaves the node — the double-vote window a crash must never reopen),
+  `raft.wal` (append-only entry log: JSON records, fsync per append
+  batch, replayed on load; torn tails are cut), and `raft.snap.json`
+  (state-machine snapshot + log base, written at compaction once the
+  log exceeds LOG_CAP, after which the WAL is rewritten to the tail).
+  Followers that fall behind the compacted base receive the snapshot
+  piggybacked on AppendEntries;
 - ``propose()`` replicates a command to a quorum before applying it to
   the state machine on every node (commands: max volume id bumps and
   sequence watermarks — the same state the reference snapshots).
@@ -99,9 +104,11 @@ class RaftNode:
         self._commit_cv = threading.Condition(self._lock)
         self._stopped = False
         self._threads: List[threading.Thread] = []
+        self._inflight: set = set()  # peers with a replicate RPC in flight
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, len(self.peers)),
             thread_name_prefix="raft-repl") if self.peers else None
+        self._wal_file = None
         self._load_state()
 
     # -- log index helpers (base-relative) ------------------------------------
@@ -116,47 +123,182 @@ class RaftNode:
         return self.log[index - self._base()]
 
     # -- persistence ---------------------------------------------------------
+    #
+    # Three files under -mdir (see module docstring): meta (term+vote,
+    # fsync'd before any reply that depends on it), an append-only WAL
+    # of entry/truncate records, and the compaction snapshot.
 
-    def _state_path(self) -> Optional[str]:
-        return os.path.join(self.meta_dir, "raft.json") \
-            if self.meta_dir else None
+    def _path(self, name: str) -> Optional[str]:
+        return os.path.join(self.meta_dir, name) if self.meta_dir else None
+
+    @staticmethod
+    def _fsync_replace(path: str, payload: str) -> None:
+        """Write-fsync-rename-fsyncdir: the file is durably either the
+        old or the new content, never torn."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dirfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+
+    def _save_meta(self) -> None:
+        """Persist term + vote. MUST complete before the vote/term is
+        acted on: a crash after granting a vote but before persisting
+        it lets the node vote twice in the term (Raft §5.2 persistence
+        rules) — exactly what the fsync closes."""
+        p = self._path("raft.meta.json")
+        if not p:
+            return
+        os.makedirs(self.meta_dir, exist_ok=True)
+        self._fsync_replace(p, json.dumps(
+            {"term": self.current_term, "voted_for": self.voted_for}))
+
+    def _wal_handle(self):
+        if self._wal_file is None and self.meta_dir:
+            os.makedirs(self.meta_dir, exist_ok=True)
+            self._wal_file = open(self._path("raft.wal"), "ab")
+        return self._wal_file
+
+    def _wal_record(self, rec: dict) -> None:
+        f = self._wal_handle()
+        if f is None:
+            return
+        f.write(json.dumps(rec).encode() + b"\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _wal_append(self, entries: List[dict]) -> None:
+        f = self._wal_handle()
+        if f is None:
+            return
+        for e in entries:
+            f.write(json.dumps({"op": "append", "entry": e}).encode()
+                    + b"\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+    def _wal_truncate_mark(self, from_index: int) -> None:
+        self._wal_record({"op": "truncate", "from": from_index})
+
+    def _rewrite_wal(self) -> None:
+        """Reset the WAL to exactly the entries after the current base
+        (after compaction / snapshot install)."""
+        p = self._path("raft.wal")
+        if not p:
+            return
+        if self._wal_file is not None:
+            self._wal_file.close()
+            self._wal_file = None
+        payload = "".join(
+            json.dumps({"op": "append", "entry": e}) + "\n"
+            for e in self.log[1:])
+        self._fsync_replace(p, payload)
+
+    def _save_snapshot(self) -> None:
+        p = self._path("raft.snap.json")
+        if not p:
+            return
+        os.makedirs(self.meta_dir, exist_ok=True)
+        self._fsync_replace(p, json.dumps(
+            {"base_index": self._base(), "base_term": self.log[0]["term"],
+             "snapshot": self.snapshot_state,
+             "commit_index": self.commit_index}))
+        self._rewrite_wal()
 
     def _load_state(self) -> None:
-        p = self._state_path()
-        if not p or not os.path.exists(p):
+        if not self.meta_dir:
             return
-        with open(p) as f:
+        legacy = self._path("raft.json")
+        if os.path.exists(legacy):
+            # the legacy file alone gates migration: its removal is the
+            # commit point, so a crash mid-migration just re-runs it
+            # (idempotent — it overwrites all three new files)
+            self._load_legacy(legacy)
+            return
+        snap_p = self._path("raft.snap.json")
+        if os.path.exists(snap_p):
+            with open(snap_p) as f:
+                st = json.load(f)
+            self.log = [{"index": st["base_index"],
+                         "term": st["base_term"], "command": None}]
+            self.snapshot_state = st.get("snapshot") or {}
+            self.commit_index = st.get("commit_index", 0)
+        wal_p = self._path("raft.wal")
+        if os.path.exists(wal_p):
+            good = 0   # byte offset of the last intact record
+            with open(wal_p, "rb") as f:
+                for line in f:
+                    if not line.endswith(b"\n"):
+                        # record+newline go down in one fsynced write,
+                        # so a newline-less tail was never acked — and
+                        # keeping it would glue the next append onto
+                        # its line, losing BOTH on the following replay
+                        break
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        break  # torn tail from a crash mid-append
+                    good += len(line)
+                    if rec["op"] == "append":
+                        e = rec["entry"]
+                        if e["index"] <= self._last_index():
+                            continue  # idempotent replay
+                        self.log.append(e)
+                    elif rec["op"] == "truncate":
+                        cut = rec["from"] - self._base()
+                        if 1 <= cut <= len(self.log):
+                            del self.log[cut:]
+            if good != os.path.getsize(wal_p):
+                # cut the torn bytes NOW, before reopening for append —
+                # otherwise later appends land beyond garbage that every
+                # future replay stops at
+                with open(wal_p, "r+b") as f:
+                    f.truncate(good)
+        meta_p = self._path("raft.meta.json")
+        if os.path.exists(meta_p):
+            with open(meta_p) as f:
+                st = json.load(f)
+            self.current_term = st.get("term", 0)
+            self.voted_for = st.get("voted_for")
+        self._finish_load()
+
+    def _load_legacy(self, path: str) -> None:
+        """Upgrade path from the round-2 single-file raft.json."""
+        with open(path) as f:
             st = json.load(f)
         self.current_term = st.get("term", 0)
         self.voted_for = st.get("voted_for")
         self.log = st.get("log") or self.log
         self.snapshot_state = st.get("snapshot") or {}
         self.commit_index = st.get("commit_index", 0)
+        self._save_meta()
+        self._save_snapshot()  # also rewrites the WAL with the tail
+        os.remove(path)
+        self._finish_load()
+
+    def _finish_load(self) -> None:
         base = self._base()
         if self.snapshot_state or base:
             self.restore_fn(self.snapshot_state)
         self.last_applied = base
+        self.commit_index = max(self.commit_index, base)
+        self.commit_index = min(self.commit_index, self._last_index())
+        if not self.peers:
+            # single-node: everything durably logged WAS committed (no
+            # quorum to re-learn it from after a restart)
+            self.commit_index = self._last_index()
         # replay committed entries beyond the snapshot base
         self._apply_committed()
 
-    def _save_state(self) -> None:
-        p = self._state_path()
-        if not p:
-            return
-        os.makedirs(self.meta_dir, exist_ok=True)
-        tmp = p + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"term": self.current_term,
-                       "voted_for": self.voted_for,
-                       "log": self.log,
-                       "snapshot": self.snapshot_state,
-                       "commit_index": self.commit_index}, f)
-        os.replace(tmp, p)
-
     def _maybe_compact(self) -> None:
         """Fold applied entries into the snapshot once the log is long
-        (caller holds the lock). Keeps raft.json and the per-append
-        rewrite cost bounded."""
+        (caller holds the lock). Keeps the WAL and replay cost bounded."""
         if len(self.log) <= self.LOG_CAP or \
                 self.last_applied <= self._base():
             return
@@ -165,6 +307,7 @@ class RaftNode:
         sentinel["command"] = None
         self.snapshot_state = self.snapshot_fn()
         self.log = [sentinel] + self.log[cut - self._base() + 1:]
+        self._save_snapshot()
         log.info("%s: compacted raft log to base %d (%d entries kept)",
                  self.my_url, cut, len(self.log) - 1)
 
@@ -186,6 +329,10 @@ class RaftNode:
             t.join(timeout=2)
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        with self._lock:
+            if self._wal_file is not None:
+                self._wal_file.close()
+                self._wal_file = None
 
     # -- role accessors ------------------------------------------------------
 
@@ -224,7 +371,7 @@ class RaftNode:
             self.leader_url = None
             self._last_heard = time.monotonic()
             last = self.log[-1]
-            self._save_state()
+            self._save_meta()
         log.info("%s: starting election for term %d", self.my_url, term)
 
         def ask(peer):
@@ -262,9 +409,9 @@ class RaftNode:
                 # prior-term entries indirectly, via a committed entry
                 # of the current term (Raft §5.4.2) — without this, a
                 # fresh leader would sit on uncommitted predecessors
-                self.log.append({"index": nxt, "term": term,
-                                 "command": None})
-                self._save_state()
+                entry = {"index": nxt, "term": term, "command": None}
+                self.log.append(entry)
+                self._wal_append([entry])
                 log.info("%s: won election for term %d (%d/%d votes)",
                          self.my_url, term, votes, len(self.peers) + 1)
         if self.is_leader:
@@ -275,7 +422,7 @@ class RaftNode:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
-            self._save_state()
+            self._save_meta()
         if self.state != FOLLOWER:
             log.info("%s: stepping down to follower (term %d, leader %s)",
                      self.my_url, term, leader)
@@ -287,13 +434,31 @@ class RaftNode:
     # -- replication (leader side) -------------------------------------------
 
     def _broadcast_heartbeat(self) -> None:
-        # parallel: one hung peer must not delay the live peers'
-        # heartbeats past their election timeouts (leader flapping)
-        futures = [self._pool.submit(self._replicate_to, p)
-                   for p in self.peers]
-        concurrent.futures.wait(
-            futures, timeout=self.election_timeout + 0.2)
-        self._advance_commit()
+        """Fire-and-track replication to every peer.
+
+        Never blocks on peer RPCs: a black-holed peer used to stretch
+        the heartbeat cycle past the followers' election timeouts and
+        flap the leadership (round-2 advisory). Instead each peer has
+        at most one RPC in flight — a slow peer is simply skipped this
+        tick while healthy peers keep their cadence — and commit
+        advancement runs from each RPC's completion callback."""
+        for p in self.peers:
+            with self._lock:
+                if p in self._inflight:
+                    continue
+                self._inflight.add(p)
+            fut = self._pool.submit(self._replicate_to, p)
+            fut.add_done_callback(
+                lambda _f, peer=p: self._replication_done(peer))
+
+    def _replication_done(self, peer: str) -> None:
+        with self._lock:
+            self._inflight.discard(peer)
+        try:
+            self._advance_commit()
+        except Exception:
+            log.exception("advance_commit failed after replicating to %s",
+                          peer)
 
     def _replicate_to(self, peer: str) -> None:
         with self._lock:
@@ -357,7 +522,6 @@ class RaftNode:
                     self.commit_index = idx
                     self._apply_committed()
                     self._maybe_compact()
-                    self._save_state()
                     self._commit_cv.notify_all()
                     break
 
@@ -382,20 +546,22 @@ class RaftNode:
             # single-node: commit immediately
             with self._lock:
                 idx = self._last_index() + 1
-                self.log.append({"index": idx, "term": self.current_term,
-                                 "command": command})
+                entry = {"index": idx, "term": self.current_term,
+                         "command": command}
+                self.log.append(entry)
+                self._wal_append([entry])  # durable before acking commit
                 self.commit_index = idx
                 self._apply_committed()
                 self._maybe_compact()
-                self._save_state()
             return
         with self._lock:
             if self.state != LEADER:
                 raise NotLeader(self.leader_url)
             idx = self._last_index() + 1
-            self.log.append({"index": idx, "term": self.current_term,
-                             "command": command})
-            self._save_state()
+            entry = {"index": idx, "term": self.current_term,
+                     "command": command}
+            self.log.append(entry)
+            self._wal_append([entry])
         # push to followers now rather than waiting for the next tick
         self._broadcast_heartbeat()
         deadline = time.monotonic() + timeout
@@ -429,7 +595,9 @@ class RaftNode:
             if grant:
                 self.voted_for = request.candidate_id
                 self._last_heard = time.monotonic()
-                self._save_state()
+                # fsync'd BEFORE the reply leaves: a crash may not
+                # forget a granted vote (double-vote window)
+                self._save_meta()
             return raft_pb2.VoteResponse(term=self.current_term,
                                          vote_granted=grant)
 
@@ -451,6 +619,7 @@ class RaftNode:
                              "command": None}]
                 self.commit_index = request.snapshot_index
                 self.last_applied = request.snapshot_index
+                self._save_snapshot()  # also resets the WAL to the base
             base = self._base()
             # log consistency check
             if request.prev_log_index > self._last_index():
@@ -463,6 +632,7 @@ class RaftNode:
                     term=self.current_term, success=False, match_index=0)
             # append / overwrite conflicting suffix (skip entries the
             # snapshot already covers)
+            appended: List[dict] = []
             for e in request.entries:
                 if e.index <= base:
                     continue
@@ -472,9 +642,16 @@ class RaftNode:
                 if e.index <= self._last_index():
                     if self._get(e.index)["term"] != e.term:
                         del self.log[e.index - base:]
+                        self._wal_truncate_mark(e.index)
                         self.log.append(entry)
+                        appended.append(entry)
                 else:
                     self.log.append(entry)
+                    appended.append(entry)
+            if appended:
+                # durable before the success reply: the leader counts
+                # this node toward quorum as soon as it answers
+                self._wal_append(appended)
             # match what the LEADER sent, not whatever tail this node
             # happens to hold: a stale suffix beyond the leader's last
             # entry must not count toward the leader's quorum math
@@ -484,8 +661,5 @@ class RaftNode:
                                         self._last_index())
                 self._apply_committed()
                 self._maybe_compact()
-            if request.entries or \
-                    request.leader_commit > self.last_applied:
-                self._save_state()
             return raft_pb2.AppendEntriesResponse(
                 term=self.current_term, success=True, match_index=match)

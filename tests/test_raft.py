@@ -202,3 +202,160 @@ def test_log_compaction_and_snapshot_catchup(tmp_path):
     finally:
         for m in masters:
             m.stop()
+
+
+# -- durability across crash/restart (round-3: raft persistence rules) --------
+
+
+def _mk_node(tmp_path, peers=(), applied=None, **kw):
+    from seaweedfs_tpu.server.raft import RaftNode
+    applied = applied if applied is not None else []
+    state = {"sum": 0}
+
+    def apply(cmd, term):
+        applied.append(cmd)
+        state["sum"] += cmd.get("v", 0)
+
+    return RaftNode(
+        "127.0.0.1:1", list(peers), str(tmp_path / "meta"), apply,
+        snapshot_fn=lambda: dict(state),
+        restore_fn=lambda s: state.update(s or {"sum": 0}), **kw), state
+
+
+def test_no_double_vote_after_crash_restart(tmp_path):
+    """A granted vote must survive a crash: Raft's persistence rule.
+    Round-2 advisory: the old raft.json was not fsynced and a restart
+    could re-grant the same term to a different candidate."""
+    from seaweedfs_tpu.pb import raft_pb2
+
+    peers = ["127.0.0.1:2", "127.0.0.1:3"]
+    node, _ = _mk_node(tmp_path, peers)
+    resp = node.RequestVote(raft_pb2.VoteRequest(
+        term=5, candidate_id="127.0.0.1:2",
+        last_log_index=0, last_log_term=0), None)
+    assert resp.vote_granted
+    node.stop()  # crash
+
+    node2, _ = _mk_node(tmp_path, peers)
+    assert node2.current_term == 5
+    assert node2.voted_for == "127.0.0.1:2"
+    # a DIFFERENT candidate in the same term must be refused
+    resp = node2.RequestVote(raft_pb2.VoteRequest(
+        term=5, candidate_id="127.0.0.1:3",
+        last_log_index=0, last_log_term=0), None)
+    assert not resp.vote_granted
+    # re-asking by the original candidate is fine (idempotent)
+    resp = node2.RequestVote(raft_pb2.VoteRequest(
+        term=5, candidate_id="127.0.0.1:2",
+        last_log_index=0, last_log_term=0), None)
+    assert resp.vote_granted
+    node2.stop()
+
+
+def test_wal_replay_restores_state_machine(tmp_path):
+    node, state = _mk_node(tmp_path)
+    for i in range(1, 6):
+        node.propose({"op": "add", "v": i})
+    assert state["sum"] == 15
+    node.stop()
+
+    applied2 = []
+    node2, state2 = _mk_node(tmp_path, applied=applied2)
+    assert state2["sum"] == 15
+    assert len(applied2) == 5
+    assert node2.commit_index == 5
+    node2.stop()
+
+
+def test_wal_torn_tail_is_cut(tmp_path):
+    node, _ = _mk_node(tmp_path)
+    node.propose({"op": "add", "v": 7})
+    node.propose({"op": "add", "v": 8})
+    node.stop()
+    with open(tmp_path / "meta" / "raft.wal", "ab") as f:
+        f.write(b'{"op": "append", "entry": {"index":')  # torn record
+
+    node2, state2 = _mk_node(tmp_path)
+    assert state2["sum"] == 15  # intact prefix replayed, tail ignored
+    node2.propose({"op": "add", "v": 1})  # and the WAL still appends
+    node2.stop()
+    node3, state3 = _mk_node(tmp_path)
+    assert state3["sum"] == 16
+    node3.stop()
+
+
+def test_compaction_snapshot_survives_restart(tmp_path):
+    node, state = _mk_node(tmp_path)
+    node.LOG_CAP = 8
+    for i in range(30):
+        node.propose({"op": "add", "v": 1})
+    assert len(node.log) <= 9  # compacted
+    node.stop()
+
+    applied2 = []
+    node2, state2 = _mk_node(tmp_path, applied=applied2)
+    assert state2["sum"] == 30
+    # only the post-snapshot tail replays through apply()
+    assert len(applied2) < 30
+    node2.stop()
+
+
+def test_legacy_raft_json_upgrade(tmp_path):
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    legacy = {
+        "term": 3, "voted_for": "127.0.0.1:2",
+        "log": [{"index": 0, "term": 0, "command": None},
+                {"index": 1, "term": 2, "command": {"op": "add", "v": 9}},
+                {"index": 2, "term": 3, "command": {"op": "add", "v": 4}}],
+        "snapshot": {}, "commit_index": 2,
+    }
+    (meta / "raft.json").write_text(json.dumps(legacy))
+    node, state = _mk_node(tmp_path)
+    assert node.current_term == 3
+    assert state["sum"] == 13
+    assert not (meta / "raft.json").exists()  # migrated to the new files
+    assert (meta / "raft.meta.json").exists()
+    assert (meta / "raft.wal").exists()
+    node.stop()
+
+
+def test_wal_newline_less_tail_is_cut(tmp_path):
+    """A record persisted without its trailing newline was never acked
+    (record+\\n go down in one fsynced write); keeping it would glue
+    the next append onto the same line and lose both."""
+    node, _ = _mk_node(tmp_path)
+    node.propose({"op": "add", "v": 5})
+    node.stop()
+    with open(tmp_path / "meta" / "raft.wal", "ab") as f:
+        f.write(b'{"op": "append", "entry": {"index": 2, "term": 0, '
+                b'"command": {"op": "add", "v": 99}}}')  # no newline
+    node2, state2 = _mk_node(tmp_path)
+    assert state2["sum"] == 5           # unacked tail dropped
+    node2.propose({"op": "add", "v": 2})
+    node2.stop()
+    node3, state3 = _mk_node(tmp_path)
+    assert state3["sum"] == 7           # the new append replays cleanly
+    node3.stop()
+
+
+def test_legacy_migration_crash_rerun(tmp_path):
+    """Crash between the migrated meta write and the snapshot write:
+    raft.json still exists, so the migration re-runs — the legacy
+    state must not be silently dropped (review round 3)."""
+    meta = tmp_path / "meta"
+    meta.mkdir()
+    legacy = {
+        "term": 4, "voted_for": None,
+        "log": [{"index": 0, "term": 0, "command": None},
+                {"index": 1, "term": 4, "command": {"op": "add", "v": 6}}],
+        "snapshot": {}, "commit_index": 1,
+    }
+    (meta / "raft.json").write_text(json.dumps(legacy))
+    # simulate the partial migration: meta written, snapshot/WAL not
+    (meta / "raft.meta.json").write_text('{"term": 4, "voted_for": null}')
+    node, state = _mk_node(tmp_path)
+    assert state["sum"] == 6
+    assert node.current_term == 4
+    assert not (meta / "raft.json").exists()
+    node.stop()
