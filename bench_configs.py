@@ -220,10 +220,65 @@ def config5() -> dict:
             "encode_throttled_200mbps_p99_ms": round(throttled, 2)}
 
 
+def config6() -> dict:
+    """Write-path A/B: round-1-style synchronous per-write commits vs
+    the round-2 group-commit worker (storage/volume.py
+    _GroupCommitWriter), measured with the in-binary load generator at
+    the reference's shape (c=16, 1KB; reference weed benchmark
+    README.md:493-503 = 15,708 req/s on 2012 hardware). Proves the
+    worker earns its complexity (round-2 verdict item 7)."""
+    import os as _os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import io
+    import tempfile
+
+    from seaweedfs_tpu.command.benchmark import run_benchmark_programmatic
+    from seaweedfs_tpu.storage import volume as volume_mod
+    from tests.cluster_util import Cluster
+
+    n = int(_os.environ.get("BENCH6_N", 100_000))
+    results = {}
+    for mode, async_write in (("sync_per_write", False),
+                              ("group_commit", True)):
+        orig = volume_mod.Volume.__init__
+
+        def patched(self, *a, **kw):
+            kw["async_write"] = async_write
+            orig(self, *a, **kw)
+
+        volume_mod.Volume.__init__ = patched
+        c = None
+        try:
+            import pathlib
+            tmp = pathlib.Path(tempfile.mkdtemp(prefix=f"bench6-{mode}-"))
+            c = Cluster(tmp, n_volume_servers=1)
+            r = run_benchmark_programmatic(
+                c.master.url, n=n, concurrency=16, size=1024,
+                do_read=False, out=io.StringIO())
+            st = r["write"]
+            ms = sorted(st.latencies_ms)
+            results[mode] = {
+                "req_per_s": round(st.completed / r["write_seconds"], 1),
+                "p50_ms": round(st.percentile(ms, 50), 2),
+                "p99_ms": round(st.percentile(ms, 99), 2),
+                "failed": st.failed,
+            }
+        finally:
+            volume_mod.Volume.__init__ = orig
+            if c is not None:
+                c.stop()
+    results["config"] = 6
+    results["n"] = n
+    results["speedup"] = round(
+        results["group_commit"]["req_per_s"] /
+        max(results["sync_per_write"]["req_per_s"], 0.001), 2)
+    return results
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     configs = {"1": config1, "2": config2, "3": config3, "4": config4,
-               "5": config5}
+               "5": config5, "6": config6}
     if which == "all":
         # each config in its own subprocess: config2 initializes the
         # TPU backend in-process, which would make config4's

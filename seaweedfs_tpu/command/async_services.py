@@ -105,17 +105,27 @@ def run_mount(args) -> int:
     p.add_argument("-filer", default="127.0.0.1:8888")
     p.add_argument("-dir", required=True, help="mount point")
     p.add_argument("-filer.path", dest="filer_path", default="/")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-allowOthers", dest="allow_others",
+                   action="store_true")
     opts = p.parse_args(args)
-    import ctypes.util
-    if not ctypes.util.find_library("fuse") and \
-            not ctypes.util.find_library("fuse3"):
-        print("mount needs libfuse, which this system does not have; "
-              "the filesystem layer (seaweedfs_tpu.filesys) still works "
-              "as a library — see tests/test_filesys.py", file=sys.stderr)
+    from seaweedfs_tpu.filesys import fuse_shim
+    if not fuse_shim.available():
+        print("mount needs libfuse + /dev/fuse, which this system does "
+              "not have; the filesystem layer (seaweedfs_tpu.filesys) "
+              "still works as a library — see tests/test_filesys.py",
+              file=sys.stderr)
         return 1
-    print("FUSE binding not wired in this build; use the library API "
-          "(seaweedfs_tpu.filesys.wfs.WFS)", file=sys.stderr)
-    return 1
+    from seaweedfs_tpu.filesys import Wfs
+    wfs = Wfs(opts.filer, collection=opts.collection,
+              replication=opts.replication)
+    m = fuse_shim.FuseMount(wfs, opts.dir, filer_path=opts.filer_path)
+    grace.on_interrupt(m.unmount)
+    try:
+        return m.mount(allow_other=opts.allow_others)
+    finally:
+        wfs.stop()
 
 
 def _wait(stoppable) -> int:

@@ -20,6 +20,26 @@ def total_size(chunks: Iterable[filer_pb2.FileChunk]) -> int:
     return max((c.offset + c.size for c in chunks), default=0)
 
 
+def truncate_chunks(chunks: Iterable[filer_pb2.FileChunk],
+                    length: int) -> List[filer_pb2.FileChunk]:
+    """Clamp a chunk list at `length`: chunks fully past the cut are
+    dropped, a straddling chunk keeps its bytes but shrinks its
+    visible size (the interval read path honors per-chunk sizes, so
+    no data rewrite is needed)."""
+    kept: List[filer_pb2.FileChunk] = []
+    for c in chunks:
+        if c.offset >= length:
+            continue
+        if c.offset + c.size > length:
+            c2 = filer_pb2.FileChunk()
+            c2.CopyFrom(c)
+            c2.size = length - c.offset
+            kept.append(c2)
+        else:
+            kept.append(c)
+    return kept
+
+
 def etag_of_chunks(chunks: List[filer_pb2.FileChunk]) -> str:
     """One chunk: its own etag. Many: md5-of-etags with a part-count
     suffix, S3 multipart style (reference filer.ETagChunks)."""

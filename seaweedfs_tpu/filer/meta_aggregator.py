@@ -138,6 +138,12 @@ class MetaAggregator:
 
     def _follow_peer(self, peer: str) -> None:
         since = self.read_progress(peer)
+        # newest peer ts already applied to the aggregated log: stream
+        # breaks resume from the (1s-batched) checkpoint, so replayed
+        # records MUST be dropped here or merged-view subscribers see
+        # duplicates (round-2 advisory — the signature guard only
+        # filters this filer's own events)
+        applied = since
         while not self._stopping:
             try:
                 call = filer_stub(peer).SubscribeLocalMetadata(
@@ -150,6 +156,9 @@ class MetaAggregator:
                     if self._stopping:
                         break
                     since = max(since, rec.ts_ns)
+                    if rec.ts_ns <= applied:
+                        continue  # checkpoint-lag replay
+                    applied = rec.ts_ns
                     ev = rec.event_notification
                     if self.signature not in ev.signatures:
                         # re-stamped with a LOCAL ts by append_event
@@ -157,7 +166,7 @@ class MetaAggregator:
                         with self._cond:
                             self.version += 1
                             self._cond.notify_all()
-                    self._mark_progress(peer, since)
+                    self._mark_progress(peer, applied)
             except grpc.RpcError:
                 pass  # peer down/restarting: retry below
             except Exception:

@@ -43,8 +43,11 @@ class FileHandle:
 
     @property
     def size(self) -> int:
+        # attributes.file_size participates so a truncate-EXTEND's
+        # zero hole is readable (POSIX: extended region reads as 0s)
         return max(filechunks.total_size(self.entry.chunks),
-                   self.dirty.total_size)
+                   self.dirty.total_size,
+                   self.entry.attributes.file_size)
 
     def read(self, offset: int, size: int) -> bytes:
         with self._lock:
@@ -97,6 +100,22 @@ class FileHandle:
         self.wfs.stub.CreateEntry(filer_pb2.CreateEntryRequest(
             directory=directory, entry=self.entry))
         self.wfs.meta_cache.insert(directory, self.entry)
+
+    def apply_truncate(self, length: int) -> None:
+        """Clamp this handle's view to `length` (kernel truncate on a
+        path with open handles — FUSE 2.x O_TRUNC arrives this way):
+        drop/trim flushed chunks AND dirty pages past the cut, or the
+        next flush would resurrect the pre-truncate bytes."""
+        with self._lock:
+            kept = filechunks.truncate_chunks(self.entry.chunks, length)
+            del self.entry.chunks[:]
+            self.entry.chunks.extend(kept)
+            self.entry.attributes.file_size = length
+            for iv in self.dirty.pop_all():
+                if iv.offset >= length:
+                    continue
+                self.dirty.add_interval(
+                    iv.data[: length - iv.offset], iv.offset)
 
     def release(self) -> None:
         self.flush()
@@ -226,6 +245,47 @@ class Wfs:
             directory=directory, name=name, is_delete_data=False,
             is_recursive=False))
         self.meta_cache.delete(directory, name)
+
+    def _update_entry(self, path: str, mutate) -> filer_pb2.Entry:
+        entry = self.getattr(path)
+        e2 = filer_pb2.Entry()
+        e2.CopyFrom(entry)
+        mutate(e2)
+        e2.attributes.mtime = int(time.time())
+        directory, name = split_path(path)
+        self.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+            directory=directory, entry=e2))
+        self.meta_cache.insert(directory, e2)
+        return e2
+
+    def truncate(self, path: str, length: int) -> None:
+        """O_TRUNC / ftruncate: drop chunks past `length`, clamp a
+        straddling chunk's visible size (the chunk-interval read path
+        honors per-chunk sizes, so no data rewrite is needed)."""
+        entry = self.getattr(path)
+        if entry.is_directory:
+            raise FuseError(21, f"EISDIR: {path}")
+
+        def mutate(e2):
+            kept = filechunks.truncate_chunks(e2.chunks, length)
+            del e2.chunks[:]
+            e2.chunks.extend(kept)
+            e2.attributes.file_size = length
+
+        # clamp open handles FIRST: once they hold the trimmed view, a
+        # racing flush writes the post-truncate chunk list instead of
+        # resurrecting the old one on the filer
+        with self._lock:
+            handles = [h for h in self._handles.values()
+                       if h.path == path]
+        for h in handles:
+            h.apply_truncate(length)
+        self._update_entry(path, mutate)
+
+    def chmod(self, path: str, mode: int) -> None:
+        self._update_entry(
+            path, lambda e2: setattr(e2.attributes, "file_mode",
+                                     mode & 0o7777))
 
     def rename(self, old: str, new: str) -> None:
         od, on = split_path(old)

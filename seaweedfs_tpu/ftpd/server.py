@@ -12,6 +12,7 @@ simple clients; not a hardened public-facing daemon.
 from __future__ import annotations
 
 import io
+import posixpath
 import socket
 import socketserver
 import threading
@@ -115,12 +116,19 @@ class _FtpHandler(socketserver.StreamRequestHandler):
         self.wfile.write(f"{code} {text}\r\n".encode())
 
     def _path(self, arg: str) -> str:
+        """Resolve a client path, normalized so '..' can never climb
+        out of the configured ftp_root (round-2 advisory: RETR/STOR/
+        DELE/RMD with ../ reached the whole filer namespace)."""
         if not arg or arg == ".":
-            return self.cwd
-        if arg.startswith("/"):
-            return arg
-        base = self.cwd.rstrip("/")
-        return f"{base}/{arg}"
+            p = self.cwd
+        elif arg.startswith("/"):
+            p = arg
+        else:
+            p = f"{self.cwd.rstrip('/')}/{arg}"
+        norm = posixpath.normpath(p)
+        # normpath on an ABSOLUTE path clamps '..' at '/', so the
+        # result cannot traverse above the root the server prepends
+        return norm if norm.startswith("/") else "/"
 
     def _open_data(self) -> Optional[socket.socket]:
         if self.pasv is None:

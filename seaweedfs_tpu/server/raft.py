@@ -109,6 +109,7 @@ class RaftNode:
             max_workers=max(1, len(self.peers)),
             thread_name_prefix="raft-repl") if self.peers else None
         self._wal_file = None
+        self._wal_epoch = 0
         self._load_state()
 
     # -- log index helpers (base-relative) ------------------------------------
@@ -159,10 +160,19 @@ class RaftNode:
         self._fsync_replace(p, json.dumps(
             {"term": self.current_term, "voted_for": self.voted_for}))
 
+    def _wal_path(self, epoch: Optional[int] = None) -> Optional[str]:
+        """The WAL is generation-stamped: the snapshot records which
+        epoch it pairs with, so a crash between writing the snapshot
+        and cleaning the previous WAL can never replay STALE entries
+        against the new base (pre-truncate suffixes would resurrect
+        and evict their committed replacements — review round 3)."""
+        e = self._wal_epoch if epoch is None else epoch
+        return self._path(f"raft.wal.{e}")
+
     def _wal_handle(self):
         if self._wal_file is None and self.meta_dir:
             os.makedirs(self.meta_dir, exist_ok=True)
-            self._wal_file = open(self._path("raft.wal"), "ab")
+            self._wal_file = open(self._wal_path(), "ab")
         return self._wal_file
 
     def _wal_record(self, rec: dict) -> None:
@@ -186,30 +196,33 @@ class RaftNode:
     def _wal_truncate_mark(self, from_index: int) -> None:
         self._wal_record({"op": "truncate", "from": from_index})
 
-    def _rewrite_wal(self) -> None:
-        """Reset the WAL to exactly the entries after the current base
-        (after compaction / snapshot install)."""
-        p = self._path("raft.wal")
+    def _save_snapshot(self) -> None:
+        """Write (new-epoch WAL tail, then snapshot naming it, then
+        remove the old WAL). The snapshot write is the commit point:
+        crash before it keeps the old (snap, WAL) pair intact; crash
+        after it loads the new pair — never a mix."""
+        p = self._path("raft.snap.json")
         if not p:
             return
+        os.makedirs(self.meta_dir, exist_ok=True)
+        old_epoch = self._wal_epoch
+        new_epoch = old_epoch + 1
         if self._wal_file is not None:
             self._wal_file.close()
             self._wal_file = None
         payload = "".join(
             json.dumps({"op": "append", "entry": e}) + "\n"
             for e in self.log[1:])
-        self._fsync_replace(p, payload)
-
-    def _save_snapshot(self) -> None:
-        p = self._path("raft.snap.json")
-        if not p:
-            return
-        os.makedirs(self.meta_dir, exist_ok=True)
+        self._fsync_replace(self._wal_path(new_epoch), payload)
         self._fsync_replace(p, json.dumps(
             {"base_index": self._base(), "base_term": self.log[0]["term"],
              "snapshot": self.snapshot_state,
-             "commit_index": self.commit_index}))
-        self._rewrite_wal()
+             "commit_index": self.commit_index,
+             "wal_epoch": new_epoch}))
+        self._wal_epoch = new_epoch
+        old = self._wal_path(old_epoch)
+        if os.path.exists(old):
+            os.remove(old)
 
     def _load_state(self) -> None:
         if not self.meta_dir:
@@ -229,7 +242,15 @@ class RaftNode:
                          "term": st["base_term"], "command": None}]
             self.snapshot_state = st.get("snapshot") or {}
             self.commit_index = st.get("commit_index", 0)
-        wal_p = self._path("raft.wal")
+            self._wal_epoch = st.get("wal_epoch", 0)
+        # drop WAL generations other than the snapshot's (a crash can
+        # strand the next epoch's pre-commit file)
+        if self.meta_dir and os.path.isdir(self.meta_dir):
+            for name in os.listdir(self.meta_dir):
+                if name.startswith("raft.wal.") and \
+                        name != f"raft.wal.{self._wal_epoch}":
+                    os.remove(os.path.join(self.meta_dir, name))
+        wal_p = self._wal_path()
         if os.path.exists(wal_p):
             good = 0   # byte offset of the last intact record
             with open(wal_p, "rb") as f:

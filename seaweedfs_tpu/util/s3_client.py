@@ -98,7 +98,11 @@ class S3Client:
         query = query or []
         headers = dict(headers or {})
         signed = self._sign(method, path, query, headers, payload)
-        qs = urllib.parse.urlencode(query)
+        # the SAME encoder (and order) as the canonical query string:
+        # urlencode's quote_plus turns spaces into '+', which strict
+        # SigV4 servers reject as SignatureDoesNotMatch
+        qs = "&".join(f"{_uri_encode(k)}={_uri_encode(v)}"
+                      for k, v in sorted(query))
         url = f"http://{self.endpoint}{urllib.parse.quote(path)}" + \
             (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=payload or None,

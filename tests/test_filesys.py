@@ -145,3 +145,68 @@ def test_rmdir_refuses_non_empty(wfs):
     wfs.rmdir("/w/full")  # empty now: succeeds
     with pytest.raises(FuseError):
         wfs.getattr("/w/full")
+
+
+# -- real kernel mount through the libfuse ctypes shim ------------------------
+
+
+def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
+    """Mount a real cluster through /dev/fuse and drive it with plain
+    os/file calls. Skipped where libfuse or /dev/fuse is unavailable
+    (the library-level tests above still cover the Wfs logic)."""
+    import os
+    import threading
+    import time
+
+    from seaweedfs_tpu.filesys import fuse_shim
+    from tests.cluster_util import Cluster
+
+    if not fuse_shim.available():
+        pytest.skip("libfuse / /dev/fuse not available")
+
+    c = Cluster(tmp_path_factory.mktemp("fusemnt"), n_volume_servers=1,
+                with_filer=True)
+    wfs = Wfs(c.filer.url)
+    mp = str(tmp_path / "mnt")
+    os.makedirs(mp)
+    m = fuse_shim.FuseMount(wfs, mp)
+    t = threading.Thread(target=m.mount, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not os.path.ismount(mp):
+        time.sleep(0.1)
+    if not os.path.ismount(mp):
+        c.stop()
+        pytest.skip("FUSE mount did not come up (no mount privilege?)")
+    try:
+        # create + read back
+        with open(f"{mp}/hello.txt", "w") as f:
+            f.write("hello from fuse")
+        assert os.listdir(mp) == ["hello.txt"]
+        with open(f"{mp}/hello.txt") as f:
+            assert f.read() == "hello from fuse"
+        assert os.stat(f"{mp}/hello.txt").st_size == 15
+        # append via truncate-less rewrite
+        with open(f"{mp}/hello.txt", "w") as f:  # O_TRUNC path
+            f.write("shorter")
+        assert os.stat(f"{mp}/hello.txt").st_size == 7
+        # directories + rename
+        os.mkdir(f"{mp}/sub")
+        os.rename(f"{mp}/hello.txt", f"{mp}/sub/hi.txt")
+        assert os.listdir(mp) == ["sub"]
+        with open(f"{mp}/sub/hi.txt") as f:
+            assert f.read() == "shorter"
+        # ENOENT surfaces as OSError
+        with pytest.raises(FileNotFoundError):
+            open(f"{mp}/nope.txt")
+        # non-empty rmdir refused, then cleanup succeeds
+        with pytest.raises(OSError):
+            os.rmdir(f"{mp}/sub")
+        os.remove(f"{mp}/sub/hi.txt")
+        os.rmdir(f"{mp}/sub")
+        assert os.listdir(mp) == []
+    finally:
+        m.unmount()
+        t.join(timeout=5)
+        wfs.stop()
+        c.stop()

@@ -61,3 +61,31 @@ def test_ftp_unknown_command_keeps_session(ftp_env):
         ftp.sendcmd("SITE CHMOD 777 x")
     assert ftp.pwd() == "/"  # session still alive
     ftp.quit()
+
+
+def test_ftp_dotdot_cannot_escape_root(tmp_path_factory):
+    """'..' in CWD/RETR must clamp at the configured ftp_root
+    (round-2 advisory: traversal reached the whole namespace)."""
+    from seaweedfs_tpu.filer import http_client
+
+    c = Cluster(tmp_path_factory.mktemp("ftpjail"), n_volume_servers=1,
+                with_filer=True)
+    srv = FtpServer(c.filer.url, port=free_port_pair(), ftp_root="/jail")
+    srv.start()
+    try:
+        http_client.put(c.filer.url, "/outside.txt", b"secret")
+        http_client.put(c.filer.url, "/jail/inside.txt", b"public")
+        ftp = _client(srv)
+        buf = io.BytesIO()
+        ftp.retrbinary("RETR inside.txt", buf.write)
+        assert buf.getvalue() == b"public"
+        # direct and cwd-based traversal both clamp at the jail root
+        with pytest.raises(ftplib.error_perm):
+            ftp.retrbinary("RETR ../outside.txt", io.BytesIO().write)
+        ftp.sendcmd("CWD ../..")
+        with pytest.raises(ftplib.error_perm):
+            ftp.retrbinary("RETR outside.txt", io.BytesIO().write)
+        ftp.quit()
+    finally:
+        srv.stop()
+        c.stop()

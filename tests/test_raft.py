@@ -272,7 +272,7 @@ def test_wal_torn_tail_is_cut(tmp_path):
     node.propose({"op": "add", "v": 7})
     node.propose({"op": "add", "v": 8})
     node.stop()
-    with open(tmp_path / "meta" / "raft.wal", "ab") as f:
+    with open(tmp_path / "meta" / "raft.wal.0", "ab") as f:
         f.write(b'{"op": "append", "entry": {"index":')  # torn record
 
     node2, state2 = _mk_node(tmp_path)
@@ -316,7 +316,7 @@ def test_legacy_raft_json_upgrade(tmp_path):
     assert state["sum"] == 13
     assert not (meta / "raft.json").exists()  # migrated to the new files
     assert (meta / "raft.meta.json").exists()
-    assert (meta / "raft.wal").exists()
+    assert any(p.name.startswith("raft.wal.") for p in meta.iterdir())
     node.stop()
 
 
@@ -327,7 +327,7 @@ def test_wal_newline_less_tail_is_cut(tmp_path):
     node, _ = _mk_node(tmp_path)
     node.propose({"op": "add", "v": 5})
     node.stop()
-    with open(tmp_path / "meta" / "raft.wal", "ab") as f:
+    with open(tmp_path / "meta" / "raft.wal.0", "ab") as f:
         f.write(b'{"op": "append", "entry": {"index": 2, "term": 0, '
                 b'"command": {"op": "add", "v": 99}}}')  # no newline
     node2, state2 = _mk_node(tmp_path)
