@@ -95,6 +95,8 @@ def _build_master(opts):
         maintenance_interval_s=float(sleep_minutes) * 60,
         sequencer_type=conf.get_string("master.sequencer.type", "memory"),
         sequencer_node_id=conf.get("master.sequencer.node_id"),
+        sequencer_etcd_urls=conf.get_string(
+            "master.sequencer.sequencer_etcd_urls", "127.0.0.1:2379"),
     )
 
 
@@ -186,7 +188,9 @@ def _filer_parser() -> argparse.ArgumentParser:
     p.add_argument("-master", default="127.0.0.1:9333")
     p.add_argument("-store", default="sqlite",
                    help="metadata store: memory | sqlite | weedkv "
-                        "(embedded log-structured KV)")
+                        "(embedded log-structured KV) | redis | etcd | "
+                        "mysql | postgres (connection params come from "
+                        "the matching filer.toml section)")
     p.add_argument("-dir", default="./filer",
                    help="directory for metadata store + event log")
     p.add_argument("-collection", default="")
@@ -204,11 +208,17 @@ def _filer_parser() -> argparse.ArgumentParser:
 
 def _build_filer(opts):
     from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.util import config as config_mod
     os.makedirs(opts.dir, exist_ok=True)
     peers = [x.strip() for x in (opts.peers or "").split(",")
              if x.strip()]
+    # the store's filer.toml section carries its connection params
+    # (reference scaffold.go [redis]/[etcd]/[mysql]/[postgres])
+    store_options = config_mod.load_configuration("filer") \
+        .get(opts.store) or {}
     return FilerServer(
         opts.master, ip=opts.ip, port=opts.port, store=opts.store,
+        store_options=store_options,
         meta_dir=opts.dir, collection=opts.collection,
         replication=opts.replication,
         chunk_size=opts.max_mb << 20, cipher=opts.cipher,

@@ -1,0 +1,184 @@
+"""Redis FilerStore over a minimal built-in RESP client (reference
+weed/filer/redis/universal_redis_store.go — which uses go-redis; this
+image has no redis SDK, so the wire protocol is spoken directly: RESP
+arrays of bulk strings, the half-dozen commands the store needs).
+
+Layout matches the reference: the serialized Entry lives at the full
+path key; each directory has a SET of child names at
+`<dir>\x00:children` powering listings.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import List, Optional
+
+from seaweedfs_tpu.filer.filerstore import (FilerStore, NotFound,
+                                            join_path, normalize_path)
+from seaweedfs_tpu.pb import filer_pb2
+
+DIR_LIST_MARKER = b"\x00:children"
+
+
+class RespError(Exception):
+    pass
+
+
+class RespClient:
+    """One redis connection; thread-safe via a lock (the store's call
+    pattern is short request/response, no pipelining needed)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 password: str = "", database: int = 0,
+                 timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._buf = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        if password:
+            self.command(b"AUTH", password.encode())
+        if database:
+            self.command(b"SELECT", str(database).encode())
+
+    def command(self, *parts: bytes):
+        with self._lock:
+            out = [b"*%d\r\n" % len(parts)]
+            for p in parts:
+                out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+            self._sock.sendall(b"".join(out))
+            return self._read_reply()
+
+    def _read_reply(self):
+        line = self._buf.readline()
+        if not line:
+            raise RespError("connection closed")
+        kind, rest = line[:1], line[1:-2]
+        if kind == b"+":
+            return rest
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._buf.read(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"bad reply type {kind!r}")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RedisStore(FilerStore):
+    name = "redis"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 password: str = "", database: int = 0):
+        self.client = RespClient(host, port, password=password,
+                                 database=database)
+
+    @staticmethod
+    def _children_key(directory: str) -> bytes:
+        return normalize_path(directory).encode() + DIR_LIST_MARKER
+
+    def insert_entry(self, directory, entry):
+        directory = normalize_path(directory)
+        path = join_path(directory, entry.name)
+        self.client.command(b"SET", path.encode(),
+                            entry.SerializeToString())
+        self.client.command(b"SADD", self._children_key(directory),
+                            entry.name.encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory, name):
+        path = join_path(normalize_path(directory), name)
+        blob = self.client.command(b"GET", path.encode())
+        if blob is None:
+            raise NotFound(path)
+        e = filer_pb2.Entry()
+        e.ParseFromString(blob)
+        return e
+
+    def delete_entry(self, directory, name):
+        directory = normalize_path(directory)
+        path = join_path(directory, name)
+        self.client.command(b"DEL", path.encode())
+        self.client.command(b"DEL", path.encode() + DIR_LIST_MARKER)
+        self.client.command(b"SREM", self._children_key(directory),
+                            name.encode())
+
+    @staticmethod
+    def _glob_escape(b: bytes) -> bytes:
+        out = bytearray()
+        for c in b:
+            if c in b"*?[\\":
+                out += b"[" + bytes([c]) + b"]"
+            else:
+                out.append(c)
+        return bytes(out)
+
+    def delete_folder_children(self, directory):
+        """Prefix sweep via cursored SCAN (non-blocking on a production
+        redis, unlike KEYS) with batched DELs: also wipes orphan
+        subtrees whose parent entry was never written (the SPI contract
+        the path-prefix SQL stores satisfy)."""
+        directory = normalize_path(directory)
+        prefix = (directory.rstrip("/") + "/").encode()
+        pattern = self._glob_escape(prefix) + b"*"
+        cursor = b"0"
+        while True:
+            reply = self.client.command(b"SCAN", cursor, b"MATCH",
+                                        pattern, b"COUNT", b"512")
+            cursor, keys = reply[0], reply[1]
+            if keys:
+                self.client.command(b"DEL", *keys)
+            if cursor == b"0":
+                break
+        self.client.command(b"DEL", self._children_key(directory))
+
+    def list_directory_entries(self, directory, start_name="",
+                               inclusive=False, limit=1024, prefix=""):
+        directory = normalize_path(directory)
+        names = sorted(
+            n.decode() for n in (self.client.command(
+                b"SMEMBERS", self._children_key(directory)) or []))
+        out: List[filer_pb2.Entry] = []
+        for name in names:
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_name:
+                if name < start_name or \
+                        (name == start_name and not inclusive):
+                    continue
+            try:
+                out.append(self.find_entry(directory, name))
+            except NotFound:
+                # child-set entry without a path key (torn write):
+                # self-heal the set instead of failing every listing
+                self.client.command(b"SREM",
+                                    self._children_key(directory),
+                                    name.encode())
+            if len(out) >= limit:
+                break
+        return out
+
+    def kv_put(self, key, value):
+        self.client.command(b"SET", b"kv:" + bytes(key), bytes(value))
+
+    def kv_get(self, key):
+        return self.client.command(b"GET", b"kv:" + bytes(key))
+
+    def close(self):
+        self.client.close()

@@ -1,145 +1,52 @@
-"""SQLite FilerStore — the embedded persistent backend, shaped like the
-reference's abstract_sql layer (weed/filer/abstract_sql/abstract_sql_store.go:
-one `filemeta(dirhash, name, directory, meta)` table; here the composite
-primary key replaces the hash, and a `filekv` table backs the KV API).
+"""SQLite FilerStore — the embedded persistent backend, now a thin
+flavor of the shared abstract-SQL layer (reference
+weed/filer/abstract_sql/abstract_sql_store.go; sqlite is the in-image
+proof that the shared layer works — mysql/postgres are sibling
+subclasses in abstract_sql.py gated on their drivers).
 """
 
 from __future__ import annotations
 
 import os
 import sqlite3
-import threading
-from typing import List, Optional
 
-from seaweedfs_tpu.filer.filerstore import FilerStore, NotFound, normalize_path
-from seaweedfs_tpu.pb import filer_pb2
-
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS filemeta (
-    directory TEXT NOT NULL,
-    name      TEXT NOT NULL,
-    meta      BLOB NOT NULL,
-    PRIMARY KEY (directory, name)
-);
-CREATE TABLE IF NOT EXISTS filekv (
-    k BLOB PRIMARY KEY,
-    v BLOB NOT NULL
-);
-"""
+from seaweedfs_tpu.filer.stores.abstract_sql import AbstractSqlStore
 
 
-class SqliteStore(FilerStore):
+class SqliteStore(AbstractSqlStore):
     name = "sqlite"
 
     def __init__(self, path: str = ":memory:"):
+        self._path = path
         if path != ":memory:":
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # one connection guarded by a lock: sqlite serializes writers
-        # anyway, and this keeps transactions coherent across threads
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.executescript(_SCHEMA)
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._lock = threading.RLock()
-        self._in_tx = 0
-
-    def insert_entry(self, directory, entry):
-        directory = normalize_path(directory)
+        super().__init__()
         with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._migrate_legacy()
+
+    def _migrate_legacy(self) -> None:
+        """Upgrade a pre-round-3 filer.db in place: the filemeta table
+        gained a dirhash PK column (caller holds the lock)."""
+        cols = [r[1] for r in self._conn.execute(
+            "PRAGMA table_info(filemeta)")]
+        if "dirhash" in cols:
+            return
+        self._conn.executescript("""
+            ALTER TABLE filemeta RENAME TO filemeta_v2;
+        """)
+        for stmt in self.create_tables:
+            self._conn.execute(stmt)
+        for directory, name, meta in self._conn.execute(
+                "SELECT directory, name, meta FROM filemeta_v2"):
             self._conn.execute(
-                "INSERT OR REPLACE INTO filemeta VALUES (?,?,?)",
-                (directory, entry.name, entry.SerializeToString()))
-            self._maybe_commit()
+                self.upsert_sql,
+                (self._dirhash(directory), directory, name, meta))
+        self._conn.execute("DROP TABLE filemeta_v2")
+        self._conn.commit()
 
-    update_entry = insert_entry
-
-    def find_entry(self, directory, name):
-        directory = normalize_path(directory)
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-                (directory, name)).fetchone()
-        if row is None:
-            raise NotFound(f"{directory}/{name}")
-        e = filer_pb2.Entry()
-        e.ParseFromString(row[0])
-        return e
-
-    def delete_entry(self, directory, name):
-        directory = normalize_path(directory)
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM filemeta WHERE directory=? AND name=?",
-                (directory, name))
-            self._maybe_commit()
-
-    def delete_folder_children(self, directory):
-        directory = normalize_path(directory)
-        prefix = directory if directory.endswith("/") else directory + "/"
-        escaped = prefix.replace("\\", "\\\\") \
-                        .replace("%", r"\%").replace("_", r"\_")
-        with self._lock:
-            self._conn.execute(
-                "DELETE FROM filemeta WHERE directory=? "
-                r"OR directory LIKE ? ESCAPE '\'",
-                (directory, escaped + "%"))
-            self._maybe_commit()
-
-    def list_directory_entries(self, directory, start_name="",
-                               inclusive=False, limit=1024, prefix=""):
-        directory = normalize_path(directory)
-        op = ">=" if inclusive else ">"
-        sql = ("SELECT meta FROM filemeta WHERE directory=? AND name "
-               f"{op} ? ")
-        args: list = [directory, start_name]
-        if prefix:
-            sql += r"AND name LIKE ? ESCAPE '\' "
-            args.append(prefix.replace("%", r"\%").replace("_", r"\_") + "%")
-        sql += "ORDER BY name LIMIT ?"
-        args.append(limit)
-        with self._lock:
-            rows = self._conn.execute(sql, args).fetchall()
-        out: List[filer_pb2.Entry] = []
-        for (blob,) in rows:
-            e = filer_pb2.Entry()
-            e.ParseFromString(blob)
-            out.append(e)
-        return out
-
-    def _maybe_commit(self):
-        if not self._in_tx:
-            self._conn.commit()
-
-    def begin_transaction(self):
-        self._lock.acquire()
-        self._in_tx += 1
-
-    def commit_transaction(self):
-        self._in_tx -= 1
-        if not self._in_tx:
-            self._conn.commit()
-        self._lock.release()
-
-    def rollback_transaction(self):
-        self._in_tx -= 1
-        if not self._in_tx:
-            self._conn.rollback()
-        self._lock.release()
-
-    def kv_put(self, key, value):
-        with self._lock:
-            self._conn.execute("INSERT OR REPLACE INTO filekv VALUES (?,?)",
-                               (bytes(key), bytes(value)))
-            self._maybe_commit()
-
-    def kv_get(self, key):
-        with self._lock:
-            row = self._conn.execute(
-                "SELECT v FROM filekv WHERE k=?", (bytes(key),)).fetchone()
-        return row[0] if row else None
-
-    def close(self):
-        with self._lock:
-            if self._conn is not None:
-                self._conn.commit()
-                self._conn.close()
-                self._conn = None
+    def _connect(self):
+        # one connection guarded by the layer's lock: sqlite serializes
+        # writers anyway, and this keeps transactions coherent across
+        # threads
+        return sqlite3.connect(self._path, check_same_thread=False)

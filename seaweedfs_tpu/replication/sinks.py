@@ -152,15 +152,39 @@ class ObjectStoreSink(ReplicationSink):
 
 
 class AzureSink(ReplicationSink):
-    """Gated: Azure Blob's SharedKey auth needs the azure-storage SDK,
-    which this image does not ship. Azure workloads can use
-    ObjectStoreSink against an S3-compatible gateway in front of Blob
-    storage (reference sink/azuresink is SDK-based the same way)."""
+    """Replicate entries into Azure Blob storage over real SharedKey
+    REST (util/azure_client — no SDK needed; the auth is plain
+    HMAC-SHA256 over a canonicalized request, the same class of client
+    as the SigV4 ObjectStoreSink). Reference:
+    weed/replication/sink/azuresink/azure_sink.go:20-100 — directories
+    map to a trailing-slash marker key, deletes include snapshots.
+    """
 
-    def __init__(self, *a, **kw):
-        raise RuntimeError(
-            "azure sink needs the azure-storage SDK (not in this image); "
-            "use the s3 sink against an S3-compatible gateway instead")
+    def __init__(self, account_name: str, account_key: str,
+                 container: str, directory: str = "",
+                 endpoint: str = ""):
+        from seaweedfs_tpu.util.azure_client import AzureBlobClient
+        self.client = AzureBlobClient(account_name, account_key,
+                                      endpoint=endpoint or None)
+        self.container = container
+        self.prefix = directory.strip("/")
+
+    def _key(self, path: str) -> str:
+        key = path.lstrip("/")
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def create_entry(self, path, entry, data):
+        if entry.is_directory:
+            return  # blob stores have no directories
+        self.client.put_blob(self.container, self._key(path), data or b"")
+
+    def delete_entry(self, path, is_directory):
+        if is_directory:
+            for name in self.client.list_blobs(
+                    self.container, prefix=self._key(path) + "/"):
+                self.client.delete_blob(self.container, name)
+        else:
+            self.client.delete_blob(self.container, self._key(path))
 
 
 SINK_FACTORIES = {

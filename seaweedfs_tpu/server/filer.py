@@ -40,6 +40,53 @@ DEFAULT_CHUNK_SIZE = 8 << 20   # -maxMB analog
 log = wlog.logger("filer")
 
 
+def make_filer_store(store: str, meta_dir: Optional[str],
+                     options: Optional[dict] = None):
+    """FilerStore factory (reference filer.toml store sections +
+    filerstore.go registry). `options` carries the store's filer.toml
+    section (hostnames, credentials, endpoints)."""
+    opts = dict(options or {})
+    if store == "memory":
+        return MemoryStore()
+    if store == "sqlite":
+        path = f"{meta_dir}/filer.db" if meta_dir else ":memory:"
+        return SqliteStore(path)
+    if store in ("weedkv", "kv", "leveldb"):
+        from seaweedfs_tpu.filer.stores.kv_store import KvFilerStore
+        if not meta_dir:
+            raise ValueError("weedkv store needs a -dir/meta_dir")
+        return KvFilerStore(f"{meta_dir}/weedkv")
+    if store == "redis":
+        from seaweedfs_tpu.filer.stores.redis_store import RedisStore
+        return RedisStore(
+            host=opts.get("host", "127.0.0.1"),
+            port=int(opts.get("port", 6379)),
+            password=opts.get("password", ""),
+            database=int(opts.get("database", 0)))
+    if store == "etcd":
+        from seaweedfs_tpu.filer.stores.etcd_store import EtcdStore
+        return EtcdStore(endpoint=opts.get("servers", "127.0.0.1:2379"))
+    if store == "mysql":
+        from seaweedfs_tpu.filer.stores.abstract_sql import MysqlStore
+        return MysqlStore(
+            host=opts.get("hostname", "localhost"),
+            port=int(opts.get("port", 3306)),
+            username=opts.get("username", ""),
+            password=opts.get("password", ""),
+            database=opts.get("database", "seaweedfs"))
+    if store == "postgres":
+        from seaweedfs_tpu.filer.stores.abstract_sql import PostgresStore
+        return PostgresStore(
+            host=opts.get("hostname", "localhost"),
+            port=int(opts.get("port", 5432)),
+            username=opts.get("username", ""),
+            password=opts.get("password", ""),
+            database=opts.get("database", "seaweedfs"))
+    raise ValueError(
+        f"unknown filer store {store!r} (memory | sqlite | weedkv | "
+        "redis | etcd | mysql | postgres)")
+
+
 class FilerServer:
     def __init__(self, master_url: str, ip: str = "127.0.0.1",
                  port: int = 8888, store: str = "memory",
@@ -48,7 +95,8 @@ class FilerServer:
                  chunk_size: int = DEFAULT_CHUNK_SIZE,
                  cipher: bool = False,
                  cache_dir: Optional[str] = None,
-                 peers: Optional[List[str]] = None):
+                 peers: Optional[List[str]] = None,
+                 store_options: Optional[dict] = None):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -56,18 +104,7 @@ class FilerServer:
         self.replication = replication
         self.chunk_size = chunk_size
         self.cipher = cipher
-        if store == "memory":
-            backend = MemoryStore()
-        elif store == "sqlite":
-            path = f"{meta_dir}/filer.db" if meta_dir else ":memory:"
-            backend = SqliteStore(path)
-        elif store in ("weedkv", "kv", "leveldb"):
-            from seaweedfs_tpu.filer.stores.kv_store import KvFilerStore
-            if not meta_dir:
-                raise ValueError("weedkv store needs a -dir/meta_dir")
-            backend = KvFilerStore(f"{meta_dir}/weedkv")
-        else:
-            raise ValueError(f"unknown filer store {store!r}")
+        backend = make_filer_store(store, meta_dir, store_options)
         self.filer = Filer(backend,
                            log_dir=f"{meta_dir}/logs" if meta_dir else None)
         self.filer.on_delete_chunks = self._delete_chunks_async

@@ -79,7 +79,8 @@ class MasterServer:
                  maintenance_scripts: Optional[List[str]] = None,
                  maintenance_interval_s: float = 17 * 60,
                  sequencer_type: str = "memory",
-                 sequencer_node_id: Optional[int] = None):
+                 sequencer_node_id: Optional[int] = None,
+                 sequencer_etcd_urls: str = "127.0.0.1:2379"):
         self.ip = ip
         self.port = port
         self.meta_dir = meta_dir
@@ -96,12 +97,18 @@ class MasterServer:
             node_id = sequencer_node_id if sequencer_node_id is not None \
                 else zlib.crc32(f"{ip}:{port}".encode()) & 0x3FF
             seq = SnowflakeSequencer(node_id=node_id)
+        elif sequencer_type == "etcd":
+            # externally-coordinated contiguous ids (reference
+            # [master.sequencer] type=etcd, sequence/etcd_sequencer.go)
+            from seaweedfs_tpu.topology.sequence import EtcdSequencer
+            seq = EtcdSequencer(
+                endpoint=sequencer_etcd_urls.split(",")[0].strip())
         elif sequencer_type in ("memory", ""):
             seq = MemorySequencer(start=self._load_sequence())
         else:
             raise ValueError(
                 f"unknown sequencer type {sequencer_type!r} "
-                "(memory | snowflake; etcd needs an etcd server)")
+                "(memory | snowflake | etcd)")
         self.topo = Topology(volume_size_limit=volume_size_limit_mb << 20,
                              sequencer=seq, pulse_seconds=pulse_seconds)
         self.growth = VolumeGrowth(self.topo)

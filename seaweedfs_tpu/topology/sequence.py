@@ -100,3 +100,71 @@ class SnowflakeSequencer:
         with self._lock:
             return (self._last_ms << 22) | (self.node_id << 12) | \
                 min(self._counter + 1, self.MAX_COUNTER)
+
+
+class EtcdSequencer:
+    """Externally-coordinated contiguous ids (reference
+    weed/sequence/etcd_sequencer.go): the high-water mark lives in one
+    etcd key, advanced in CAS-claimed batches so any number of masters
+    (even without raft) hand out disjoint ranges. Rides the JSON
+    gateway client (util/etcd_client.py), no SDK."""
+
+    KEY = b"weed_master_sequence"
+    STEP = 100  # ids claimed per CAS round-trip (reference's batch)
+    # etcd IS the watermark; nothing to snapshot locally
+    needs_watermark = False
+    persistable = False
+
+    def __init__(self, endpoint: str = "127.0.0.1:2379"):
+        from seaweedfs_tpu.util.etcd_client import EtcdClient
+        self.client = EtcdClient(endpoint)
+        self._lock = threading.Lock()
+        self._next = 0   # next id to hand out locally
+        self._ceiling = 0  # end (exclusive) of the claimed range
+
+    def _claim(self, at_least: int) -> None:
+        """CAS-advance the shared counter until a batch is claimed."""
+        while True:
+            cur = self.client.get(self.KEY)
+            floor = int(cur) if cur else 1
+            want = max(floor, at_least)
+            new_ceiling = want + self.STEP
+            if self.client.cas(self.KEY, cur, str(new_ceiling).encode()):
+                self._next = want
+                self._ceiling = new_ceiling
+                return
+
+    def next_batch(self, count: int = 1) -> int:
+        with self._lock:
+            if self._next + count > self._ceiling:
+                self._claim(self._next)
+                while self._next + count > self._ceiling:
+                    # huge batch: keep claiming contiguously
+                    cur = self.client.get(self.KEY)
+                    if cur and int(cur) == self._ceiling and \
+                            self.client.cas(
+                                self.KEY, cur,
+                                str(self._ceiling + self.STEP).encode()):
+                        self._ceiling += self.STEP
+                    else:
+                        # lost contiguity to another master: restart
+                        self._claim(self._ceiling)
+            first = self._next
+            self._next += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            # ids below our claimed ceiling can only be our own or
+            # another master's already-CAS-claimed range — no conflict.
+            # Only an id at/above the ceiling means the etcd counter
+            # state was lost (wiped cluster) and the floor must be
+            # pushed up; re-claiming on every heartbeat would burn a
+            # full STEP batch each time (review round 3).
+            if seen >= self._ceiling:
+                self._claim(seen + 1)
+
+    @property
+    def peek(self) -> int:
+        with self._lock:
+            return self._next

@@ -1,0 +1,312 @@
+"""In-process fake servers for external-store adapters.
+
+Each speaks the real wire protocol its adapter uses (RESP for redis,
+the etcd v3 JSON gateway, Azure Blob REST with SharedKey verification)
+so the adapters are exercised over actual sockets, not mocks.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from tests.cluster_util import free_port_pair
+
+
+# -- redis (RESP) -------------------------------------------------------------
+
+
+class FakeRedisServer:
+    """Dict+sets backend speaking enough RESP for RedisStore:
+    SET/GET/DEL/SADD/SREM/SMEMBERS/AUTH/SELECT/PING."""
+
+    def __init__(self):
+        self.data: Dict[bytes, bytes] = {}
+        self.sets: Dict[bytes, set] = {}
+        self.port = free_port_pair()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        parts = self._read_command()
+                    except (ValueError, ConnectionError):
+                        return
+                    if parts is None:
+                        return
+                    self._dispatch(parts)
+
+            def _read_command(self) -> Optional[List[bytes]]:
+                line = self.rfile.readline()
+                if not line:
+                    return None
+                if not line.startswith(b"*"):
+                    raise ValueError("inline commands unsupported")
+                n = int(line[1:])
+                parts = []
+                for _ in range(n):
+                    hdr = self.rfile.readline()
+                    size = int(hdr[1:])
+                    parts.append(self.rfile.read(size + 2)[:-2])
+                return parts
+
+            def _reply(self, b: bytes):
+                self.wfile.write(b)
+
+            def _dispatch(self, parts: List[bytes]):
+                cmd = parts[0].upper()
+                if cmd in (b"AUTH", b"SELECT", b"PING"):
+                    self._reply(b"+OK\r\n")
+                elif cmd == b"SET":
+                    outer.data[parts[1]] = parts[2]
+                    self._reply(b"+OK\r\n")
+                elif cmd == b"GET":
+                    v = outer.data.get(parts[1])
+                    if v is None:
+                        self._reply(b"$-1\r\n")
+                    else:
+                        self._reply(b"$%d\r\n%s\r\n" % (len(v), v))
+                elif cmd == b"DEL":
+                    n = 0
+                    for k in parts[1:]:
+                        n += outer.data.pop(k, None) is not None
+                        n += outer.sets.pop(k, None) is not None
+                    self._reply(b":%d\r\n" % n)
+                elif cmd == b"SADD":
+                    s = outer.sets.setdefault(parts[1], set())
+                    before = len(s)
+                    s.update(parts[2:])
+                    self._reply(b":%d\r\n" % (len(s) - before))
+                elif cmd == b"SREM":
+                    s = outer.sets.get(parts[1], set())
+                    n = len(s)
+                    s.difference_update(parts[2:])
+                    self._reply(b":%d\r\n" % (n - len(s)))
+                elif cmd in (b"KEYS", b"SCAN"):
+                    import fnmatch
+                    if cmd == b"SCAN":
+                        pat = b"*"
+                        for i in range(2, len(parts) - 1):
+                            if parts[i].upper() == b"MATCH":
+                                pat = parts[i + 1]
+                    else:
+                        pat = parts[1]
+                    keys = [k for k in
+                            list(outer.data) + list(outer.sets)
+                            if fnmatch.fnmatchcase(
+                                k.decode("latin1"),
+                                pat.decode("latin1"))]
+                    body = [b"*%d\r\n" % len(keys)]
+                    for k in keys:
+                        body.append(b"$%d\r\n%s\r\n" % (len(k), k))
+                    if cmd == b"SCAN":
+                        # one full pass: cursor 0 terminates
+                        self._reply(b"*2\r\n$1\r\n0\r\n" + b"".join(body))
+                    else:
+                        self._reply(b"".join(body))
+                elif cmd == b"SMEMBERS":
+                    members = sorted(outer.sets.get(parts[1], set()))
+                    out = [b"*%d\r\n" % len(members)]
+                    for m in members:
+                        out.append(b"$%d\r\n%s\r\n" % (len(m), m))
+                    self._reply(b"".join(out))
+                else:
+                    self._reply(b"-ERR unknown command\r\n")
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- etcd v3 JSON gateway -----------------------------------------------------
+
+
+class FakeEtcdServer:
+    """Sorted-dict KV implementing /v3/kv/{put,range,deleterange,txn}."""
+
+    def __init__(self):
+        self.kv: Dict[bytes, bytes] = {}
+        self.port = free_port_pair()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)) or 0)
+                    or b"{}")
+                resp = outer._handle(self.path, body)
+                blob = json.dumps(resp).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                           Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _b(d: dict, k: str) -> bytes:
+        return base64.b64decode(d.get(k, ""))
+
+    def _select(self, body: dict) -> List[bytes]:
+        key = self._b(body, "key")
+        if "range_end" in body:
+            end = self._b(body, "range_end")
+            return sorted(k for k in self.kv if key <= k < end)
+        return [key] if key in self.kv else []
+
+    def _handle(self, path: str, body: dict) -> dict:
+        if path == "/v3/kv/put":
+            self.kv[self._b(body, "key")] = self._b(body, "value")
+            return {}
+        if path == "/v3/kv/range":
+            keys = self._select(body)
+            limit = int(body.get("limit", 0) or 0)
+            if limit:
+                keys = keys[:limit]
+            return {"kvs": [
+                {"key": base64.b64encode(k).decode(),
+                 "value": base64.b64encode(self.kv[k]).decode()}
+                for k in keys], "count": str(len(keys))}
+        if path == "/v3/kv/deleterange":
+            keys = self._select(body)
+            for k in keys:
+                del self.kv[k]
+            return {"deleted": str(len(keys))}
+        if path == "/v3/kv/txn":
+            ok = True
+            for cmp in body.get("compare", []):
+                key = self._b(cmp, "key")
+                if cmp.get("target") == "CREATE":
+                    ok = ok and key not in self.kv
+                else:
+                    ok = ok and self.kv.get(key) == self._b(cmp, "value")
+            ops = body.get("success" if ok else "failure", [])
+            for op in ops:
+                put = op.get("request_put")
+                if put:
+                    self.kv[self._b(put, "key")] = self._b(put, "value")
+            return {"succeeded": ok}
+        return {}
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- Azure Blob with SharedKey verification -----------------------------------
+
+
+class FakeAzureServer:
+    """Blob CRUD + listing; every request's SharedKey signature is
+    re-derived from the raw wire request and must match."""
+
+    def __init__(self, account: str, key_b64: str):
+        from seaweedfs_tpu.util import azure_client
+        self.account = account
+        self.key = key_b64
+        self.blobs: Dict[str, bytes] = {}
+        self.port = free_port_pair()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _verify(self, payload: bytes) -> bool:
+                parsed = urllib.parse.urlsplit(self.path)
+                query = urllib.parse.parse_qsl(parsed.query)
+                headers = {k: v for k, v in self.headers.items()}
+                sts = azure_client.string_to_sign(
+                    self.command, outer.account,
+                    urllib.parse.unquote(parsed.path), query, headers,
+                    len(payload))
+                want = (f"SharedKey {outer.account}:"
+                        f"{azure_client.sign(outer.account, outer.key, sts)}")
+                return self.headers.get("Authorization") == want
+
+            def _respond(self, status: int, body: bytes = b"",
+                         headers: Optional[dict] = None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _key(self) -> str:
+                return urllib.parse.unquote(
+                    urllib.parse.urlsplit(self.path).path).lstrip("/")
+
+            def do_PUT(self):
+                payload = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0) or 0))
+                if not self._verify(payload):
+                    self._respond(403, b"signature mismatch")
+                    return
+                outer.blobs[self._key()] = payload
+                self._respond(201)
+
+            def do_GET(self):
+                if not self._verify(b""):
+                    self._respond(403, b"signature mismatch")
+                    return
+                parsed = urllib.parse.urlsplit(self.path)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                if query.get("comp") == "list":
+                    container = parsed.path.lstrip("/")
+                    prefix = f"{container}/" + query.get("prefix", "")
+                    names = sorted(
+                        k[len(container) + 1:] for k in outer.blobs
+                        if k.startswith(prefix))
+                    xml = "<EnumerationResults><Blobs>" + "".join(
+                        f"<Blob><Name>{n}</Name></Blob>"
+                        for n in names) + \
+                        "</Blobs><NextMarker/></EnumerationResults>"
+                    self._respond(200, xml.encode())
+                    return
+                blob = outer.blobs.get(self._key())
+                if blob is None:
+                    self._respond(404)
+                else:
+                    self._respond(200, blob)
+
+            def do_DELETE(self):
+                if not self._verify(b""):
+                    self._respond(403, b"signature mismatch")
+                    return
+                if outer.blobs.pop(self._key(), None) is None:
+                    self._respond(404)
+                else:
+                    self._respond(202)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                           Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

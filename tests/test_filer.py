@@ -12,8 +12,10 @@ from seaweedfs_tpu.filer.filerstore import join_path, split_path
 from seaweedfs_tpu.pb import filer_pb2
 
 
-@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv"])
+@pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv",
+                        "redis", "etcd"])
 def store(request, tmp_path):
+    server = None
     if request.param == "memory":
         s = MemoryStore()
     elif request.param == "weedkv":
@@ -21,10 +23,23 @@ def store(request, tmp_path):
         s = KvFilerStore(str(tmp_path / "weedkv"))
     elif request.param == "sqlite":
         s = SqliteStore()
+    elif request.param == "redis":
+        # real RESP over a socket against the in-process fake server
+        from seaweedfs_tpu.filer.stores.redis_store import RedisStore
+        from tests.fake_backends import FakeRedisServer
+        server = FakeRedisServer()
+        s = RedisStore(port=server.port)
+    elif request.param == "etcd":
+        from seaweedfs_tpu.filer.stores.etcd_store import EtcdStore
+        from tests.fake_backends import FakeEtcdServer
+        server = FakeEtcdServer()
+        s = EtcdStore(endpoint=f"127.0.0.1:{server.port}")
     else:
         s = SqliteStore(str(tmp_path / "meta" / "filer.db"))
     yield s
     s.close()
+    if server is not None:
+        server.stop()
 
 
 @pytest.fixture
@@ -297,3 +312,32 @@ class TestReviewRegressions:
         # far-future since: nothing, and no crash from skipped segments
         assert f.meta_log.read_events_since(ts + 10**15) == []
         f.close()
+
+
+def test_sqlite_legacy_schema_migration(tmp_path):
+    """A round-2 filer.db (filemeta without dirhash) upgrades in place
+    on open, keeping every entry readable."""
+    import sqlite3
+
+    path = str(tmp_path / "old" / "filer.db")
+    import os
+    os.makedirs(os.path.dirname(path))
+    conn = sqlite3.connect(path)
+    conn.executescript("""
+        CREATE TABLE filemeta (
+            directory TEXT NOT NULL, name TEXT NOT NULL,
+            meta BLOB NOT NULL, PRIMARY KEY (directory, name));
+    """)
+    e = new_entry("legacy.txt")
+    conn.execute("INSERT INTO filemeta VALUES (?,?,?)",
+                 ("/docs", e.name, e.SerializeToString()))
+    conn.commit()
+    conn.close()
+
+    s = SqliteStore(path)
+    got = s.find_entry("/docs", "legacy.txt")
+    assert got.name == "legacy.txt"
+    s.insert_entry("/docs", new_entry("new.txt"))
+    assert [x.name for x in s.list_directory_entries("/docs")] == \
+        ["legacy.txt", "new.txt"]
+    s.close()
