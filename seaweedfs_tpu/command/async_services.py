@@ -90,10 +90,16 @@ def run_msg_broker(args) -> int:
     p.add_argument("-port", type=int, default=17777)
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-filer", default="127.0.0.1:8888")
+    p.add_argument("-peers", default="",
+                   help="comma-separated host:port of ALL brokers in "
+                        "this cluster (topics consistent-hash over "
+                        "them)")
     opts = p.parse_args(args)
     from seaweedfs_tpu.messaging.broker import MessageBroker
     broker = MessageBroker(filer_url=opts.filer, ip=opts.ip,
-                           port=opts.port)
+                           port=opts.port,
+                           peers=opts.peers.split(",") if opts.peers
+                           else None)
     broker.start()
     log.info("message broker %s:%d started", opts.ip, opts.port)
     return _wait(broker)

@@ -63,10 +63,13 @@ class MessageBroker:
     is attached (transient topics skip persistence)."""
 
     def __init__(self, filer_url: str = "", ip: str = "127.0.0.1",
-                 port: int = 17777):
+                 port: int = 17777, peers: Optional[List[str]] = None):
         self.filer_url = filer_url
         self.ip = ip
         self.port = port
+        # all brokers of this cluster (incl. self); FindBroker
+        # consistent-hashes topics over this list
+        self.peers = [p.strip() for p in (peers or []) if p.strip()]
         self._topics: Dict[Tuple[str, str], _Topic] = {}
         self._lock = threading.Lock()
         self._grpc_server = None
@@ -221,6 +224,18 @@ class MessageBroker:
                 context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                               "publish before init")
             if req.data.is_close:
+                # fixed-partition streams (channels) append the close
+                # marker into the log so subscribers observe the end of
+                # stream (reference broker_grpc_server_publish.go:88-93
+                # AddToBuffer before break); keyed fan-out topics have
+                # no single close partition and skip it
+                if 0 <= partition < len(topic_obj.partitions):
+                    ts = req.data.event_time_ns or time.time_ns()
+                    blob = req.data.SerializeToString()
+                    final_ts = topic_obj.partitions[partition].append(
+                        ts, blob)
+                    self._persist(ns, topic, topic_obj, partition,
+                                  final_ts, blob)
                 yield messaging_pb2.PublishResponse(is_closed=True)
                 return
             ts = req.data.event_time_ns or time.time_ns()
@@ -301,7 +316,16 @@ class MessageBroker:
             configuration=t.config)
 
     def FindBroker(self, request, context):
-        # single-broker deployment: always this broker; multi-broker
-        # clusters consistent-hash (namespace, topic, partition) over
-        # the broker list exactly like topics hash keys to partitions
-        return messaging_pb2.FindBrokerResponse(broker=self.url)
+        """Which broker owns a TOPIC: consistent hash over the
+        configured broker cluster (reference
+        broker/consistent_distribution.go PickMember) — every broker
+        answers identically, so clients may bootstrap from any one.
+        Placement is per topic, not per partition: this broker's
+        partition logs, configuration, and delete are whole-topic
+        state, so splitting one topic's partitions across brokers
+        would strand subscribers on empty logs."""
+        members = self.peers or [self.url]
+        from seaweedfs_tpu.messaging.consistent import pick_member
+        key = f"{request.namespace}/{request.topic}".encode()
+        return messaging_pb2.FindBrokerResponse(
+            broker=pick_member(members, key))
