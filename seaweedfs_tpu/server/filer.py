@@ -518,6 +518,12 @@ def _make_http_handler(fs: FilerServer):
             last = params.get("lastFileName", [""])[0]
             entries = fs.filer.list_entries(path, start_name=last,
                                             inclusive=False, limit=limit)
+            # browsers get the directory-browser UI (reference
+            # weed/server/filer_ui/ renders HTML when the client
+            # accepts it; API clients keep the JSON listing)
+            if "text/html" in (self.headers.get("Accept") or ""):
+                self._list_dir_html(path, entries)
+                return
             self._json({
                 "Path": path,
                 "Entries": [_entry_json(e, path) for e in entries],
@@ -525,6 +531,49 @@ def _make_http_handler(fs: FilerServer):
                 "LastFileName": entries[-1].name if entries else "",
                 "ShouldDisplayLoadMore": len(entries) == limit,
             })
+
+        def _list_dir_html(self, path: str, entries) -> None:
+            import html as _html
+
+            def link(p: str) -> str:
+                # percent-encode THEN html-escape: names may contain
+                # URL-reserved chars (#, ?, %) the browser would
+                # otherwise misparse out of the href
+                return _html.escape(urllib.parse.quote(p), quote=True)
+
+            crumbs, acc = ['<a href="/">/</a>'], ""
+            for part in [p for p in path.split("/") if p]:
+                acc += "/" + part
+                crumbs.append(
+                    f'<a href="{link(acc)}/">{_html.escape(part)}</a>')
+            rows = []
+            for e in entries:
+                href = link(join_path(path, e.name))
+                name = _html.escape(e.name)
+                if e.is_directory:
+                    rows.append(
+                        f'<tr><td><a href="{href}/">{name}/</a></td>'
+                        "<td>-</td></tr>")
+                else:
+                    # same size formula as the JSON listing and the
+                    # file-serving path (filechunks.total_size)
+                    size = filechunks.total_size(e.chunks)
+                    rows.append(
+                        f'<tr><td><a href="{href}">{name}</a></td>'
+                        f"<td>{size}</td></tr>")
+            body = ("<html><head><title>seaweedfs-tpu filer</title>"
+                    "</head><body>"
+                    f"<h1>Filer {fs.ip}:{fs.port}</h1>"
+                    f"<p>{' / '.join(crumbs)}</p>"
+                    "<table border=1 cellpadding=4>"
+                    "<tr><th>name</th><th>size</th></tr>"
+                    + "".join(rows) + "</table></body></html>").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
 
         def _serve_file(self, path: str, entry: filer_pb2.Entry) -> None:
             size = filechunks.total_size(entry.chunks)

@@ -1,8 +1,10 @@
-""".idx file codec: an append log of 16-byte (key, offset, size) entries.
+""".idx file codec: an append log of (key, offset, size) entries.
 
 Reference: weed/storage/idx/walk.go:12-50. Entries are big-endian:
-key(8) offset(4, unit of 8 bytes) size(4, int32 semantics). A tombstone is
-size == -1 (0xFFFFFFFF); its offset points at the delete marker appended to
+key(8) offset(OFFSET_SIZE, unit of 8 bytes) size(4, int32 semantics) —
+16 bytes in the default build, 17 with the 5-byte-offset variant
+(types.py SEAWEEDFS_TPU_5BYTE_OFFSET). A tombstone is size == -1
+(0xFFFFFFFF); its offset points at the delete marker appended to
 the .dat file.
 
 Parsing is vectorized with numpy (a 1M-entry .idx parses in ~10ms), which
@@ -18,15 +20,21 @@ import numpy as np
 
 from seaweedfs_tpu.storage import types as t
 
-ENTRY = struct.Struct(">QII")
+_KEY = struct.Struct(">Q")
+_SIZE = struct.Struct(">I")
 
 
 def entry_to_bytes(key: int, actual_offset: int, size: int) -> bytes:
-    return ENTRY.pack(key, actual_offset // t.NEEDLE_PADDING, size & 0xFFFFFFFF)
+    return _KEY.pack(key) + \
+        t.offset_units_to_bytes(actual_offset // t.NEEDLE_PADDING) + \
+        _SIZE.pack(size & 0xFFFFFFFF)
 
 
 def parse_entry(b: bytes) -> Tuple[int, int, int]:
-    key, off_u, size_u = ENTRY.unpack(b)
+    key = _KEY.unpack(b[:8])[0]
+    off_u = t.bytes_to_offset_units(b[8:8 + t.OFFSET_SIZE])
+    size_u = _SIZE.unpack(b[8 + t.OFFSET_SIZE:
+                            8 + t.OFFSET_SIZE + 4])[0]
     return key, off_u * t.NEEDLE_PADDING, t.size_to_int32(size_u)
 
 
@@ -36,11 +44,17 @@ def parse_index_bytes(buf: bytes) -> np.ndarray:
     Returns a record array with fields key(u8), offset(i8, actual bytes),
     size(i4). Truncates any torn trailing partial entry.
     """
-    usable = len(buf) - (len(buf) % t.NEEDLE_MAP_ENTRY_SIZE)
-    raw = np.frombuffer(buf[:usable], dtype=np.uint8).reshape(-1, 16)
+    es = t.NEEDLE_MAP_ENTRY_SIZE
+    usable = len(buf) - (len(buf) % es)
+    raw = np.frombuffer(buf[:usable], dtype=np.uint8).reshape(-1, es)
     keys = raw[:, :8].copy().view(">u8").reshape(-1)
-    offsets = raw[:, 8:12].copy().view(">u4").reshape(-1).astype(np.int64) * t.NEEDLE_PADDING
-    sizes = raw[:, 12:16].copy().view(">u4").reshape(-1).astype(np.int64)
+    offsets = raw[:, 8:12].copy().view(">u4").reshape(-1).astype(np.int64)
+    if t.OFFSET_SIZE == 5:
+        # 5th byte carries bits 32..39 (reference offset_5bytes.go)
+        offsets |= raw[:, 12].astype(np.int64) << 32
+    offsets *= t.NEEDLE_PADDING
+    so = 8 + t.OFFSET_SIZE
+    sizes = raw[:, so:so + 4].copy().view(">u4").reshape(-1).astype(np.int64)
     sizes = np.where(sizes >= (1 << 31), sizes - (1 << 32), sizes).astype(np.int32)
     out = np.zeros(len(keys), dtype=[("key", np.uint64), ("offset", np.int64),
                                      ("size", np.int32)])

@@ -1,30 +1,40 @@
 """Core on-disk scalar types and the file-id grammar.
 
 Mirrors the reference's weed/storage/types (needle_types.go:34-39,
-offset_4bytes.go) and weed/storage/needle/file_id.go behavior:
+offset_4bytes.go / offset_5bytes.go) and weed/storage/needle/file_id.go:
   - NeedleId: 8 bytes big-endian
-  - Offset: 4 bytes big-endian, in units of 8 (NEEDLE_PADDING) -> 32GB max
+  - Offset: 4 bytes big-endian (default), in units of 8
+    (NEEDLE_PADDING) -> 32GB volumes; setting
+    SEAWEEDFS_TPU_5BYTE_OFFSET=1 in the environment selects the
+    reference's `-tags 5BytesOffset` build variant (Makefile:18): a
+    5th HIGH byte after the little-32 big-endian prefix -> 8TB
+    volumes. Like the reference's build tag this is a
+    process-lifetime, deployment-wide format choice — .idx files
+    written by the two variants are incompatible.
   - Size: 4 bytes big-endian, int32 semantics; -1 (0xFFFFFFFF) = tombstone
   - fid string: "<volumeId>,<key hex><cookie 8-hex>"
 """
 
 from __future__ import annotations
 
+import os
 import secrets
 import struct
 from dataclasses import dataclass
 
 NEEDLE_ID_SIZE = 8
-OFFSET_SIZE = 4
+OFFSET_SIZE = 5 if os.environ.get("SEAWEEDFS_TPU_5BYTE_OFFSET") == "1" \
+    else 4
 SIZE_SIZE = 4
 COOKIE_SIZE = 4
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
-NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16 or 17
 TIMESTAMP_SIZE = 8
 NEEDLE_PADDING = 8
 NEEDLE_CHECKSUM_SIZE = 4
 TOMBSTONE_SIZE = -1  # Size(-1) marks a deleted needle in the index
-MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB (4B offset * 8)
+# (2^(8*OFFSET_SIZE)) padding units: 32GB at 4 bytes, 8TB at 5
+MAX_POSSIBLE_VOLUME_SIZE = (1 << (8 * OFFSET_SIZE)) * NEEDLE_PADDING
 
 
 def size_is_deleted(size: int) -> bool:
@@ -40,16 +50,33 @@ def size_to_int32(size: int) -> int:
     return size - (1 << 32) if size >= (1 << 31) else size
 
 
+def offset_units_to_bytes(units: int) -> bytes:
+    """Padding-unit offset -> wire bytes. 4-byte: plain big-endian.
+    5-byte: big-endian low 32 bits THEN the high byte (reference
+    offset_5bytes.go OffsetToBytes — the prefix stays identical to the
+    4-byte format for offsets under 32GB)."""
+    if OFFSET_SIZE == 4:
+        return struct.pack(">I", units)
+    return struct.pack(">I", units & 0xFFFFFFFF) + bytes([units >> 32])
+
+
+def bytes_to_offset_units(b: bytes) -> int:
+    low = struct.unpack(">I", b[:4])[0]
+    if OFFSET_SIZE == 4:
+        return low
+    return (b[4] << 32) | low
+
+
 def offset_to_bytes(actual_offset: int) -> bytes:
-    """Store actual byte offset / 8 as 4 bytes big-endian."""
+    """Store actual byte offset / 8 as OFFSET_SIZE wire bytes."""
     if actual_offset % NEEDLE_PADDING != 0:
         raise ValueError(f"offset {actual_offset} not 8-byte aligned")
-    return struct.pack(">I", actual_offset // NEEDLE_PADDING)
+    return offset_units_to_bytes(actual_offset // NEEDLE_PADDING)
 
 
 def bytes_to_offset(b: bytes) -> int:
     """Return the *actual* byte offset (stored unit * 8)."""
-    return struct.unpack(">I", b)[0] * NEEDLE_PADDING
+    return bytes_to_offset_units(b) * NEEDLE_PADDING
 
 
 def new_cookie() -> int:

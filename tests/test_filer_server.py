@@ -264,3 +264,24 @@ def test_ttl_upload_assigns_valid_volume_ttl(cluster):
     assert ttl_string(200) == "200s"
     assert ttl_string(0) == ""
     assert ttl_string(86400 * 400) == "58w"
+
+
+def test_filer_html_directory_browser(cluster):
+    """Browsers (Accept: text/html) get the directory-browser UI;
+    API clients keep JSON (reference weed/server/filer_ui)."""
+    import urllib.request
+
+    from seaweedfs_tpu.filer import http_client
+    http_client.put(cluster.filer.url, "/ui/docs/page.txt", b"hi")
+    req = urllib.request.Request(
+        f"http://{cluster.filer.url}/ui/docs/",
+        headers={"Accept": "text/html"})
+    with urllib.request.urlopen(req) as r:
+        body = r.read().decode()
+        assert r.headers["Content-Type"].startswith("text/html")
+    assert "page.txt" in body and "<table" in body
+    # JSON path unchanged
+    with cluster.http(f"http://{cluster.filer.url}/ui/docs/") as r:
+        import json
+        data = json.load(r)
+    assert data["Entries"][0]["FullPath"].endswith("page.txt")

@@ -432,3 +432,58 @@ def test_group_commit_batched_fsync(tmp_path):
                        fsync=True)
     assert v.file_count == 8
     v.close()
+
+
+def test_5byte_offset_variant(tmp_path):
+    """The reference's `-tags 5BytesOffset` build (8TB volumes,
+    types/offset_5bytes.go) maps to SEAWEEDFS_TPU_5BYTE_OFFSET=1 —
+    format constants are bound at import, so the variant runs in a
+    subprocess."""
+    import subprocess
+    import sys
+
+    prog = r"""
+import numpy as np
+from seaweedfs_tpu.storage import idx as idx_codec
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+assert t.OFFSET_SIZE == 5
+assert t.NEEDLE_MAP_ENTRY_SIZE == 17
+assert t.MAX_POSSIBLE_VOLUME_SIZE == (1 << 40) * 8  # 8TB
+
+# scalar codec: offsets beyond the 4-byte 32GB cap round-trip
+big = 5 * (1 << 40)  # 5TB, 8-aligned
+b = idx_codec.entry_to_bytes(7, big, 123)
+assert len(b) == 17
+assert idx_codec.parse_entry(b) == (7, big, 123)
+# the low-32 prefix matches the 4-byte wire format (reference layout)
+small = idx_codec.entry_to_bytes(7, 4096, 9)
+assert small[8:12] == (4096 // 8).to_bytes(4, "big")
+
+# vectorized parse agrees with the scalar one across the boundary
+blob = b"".join(idx_codec.entry_to_bytes(k, off, sz) for k, off, sz in [
+    (1, 8, 10), (2, (1 << 35) + 8, 20), (3, 7 * (1 << 40), -1)])
+arr = idx_codec.parse_index_bytes(blob)
+assert list(arr["offset"]) == [8, (1 << 35) + 8, 7 * (1 << 40)]
+assert list(arr["size"]) == [10, 20, -1]
+
+# and a real volume still round-trips end to end
+import sys
+v = Volume(sys.argv[1], "", 1)
+v.write_needle(Needle(id=1, cookie=3, data=b"five byte offsets"))
+v.close()
+v2 = Volume(sys.argv[1], "", 1, create_if_missing=False)
+assert v2.read_needle(Needle(id=1, cookie=3)).data == b"five byte offsets"
+v2.close()
+print("OK")
+"""
+    import os
+    env = dict(os.environ, SEAWEEDFS_TPU_5BYTE_OFFSET="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", prog, str(tmp_path)],
+                       capture_output=True, text=True, env=env,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "OK" in r.stdout
