@@ -128,7 +128,7 @@ def _volume_parser() -> argparse.ArgumentParser:
     p.add_argument("-compactionMBps", dest="compaction_mbps", type=float,
                    default=0.0)
     p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
-                   choices=["auto", "jax", "native", "numpy"])
+                   choices=["auto", "jax", "native", "numpy", "pallas"])
     p.add_argument("-index", dest="needle_map_kind", default="memory",
                    choices=["memory", "kv"],
                    help="needle map kind: memory (dict rebuild from .idx) "

@@ -8,6 +8,9 @@ shards, systematic code, Vandermonde-derived coding matrix.
 Backends:
   - "jax":   bit-matrix matmul on the default JAX backend (TPU in prod,
              CPU in tests) — see seaweedfs_tpu/ops/rs_kernel.py
+  - "pallas": fused Pallas TPU kernel (ops/rs_pallas.py) — opt-in;
+             byte-identical, measured slower than "jax" on the
+             tunneled v5e toolchain (see rs_pallas docstring)
   - "numpy": table-gather encoder on host (CPU reference / fallback)
   - "native": C++ shared library when built (seaweedfs_tpu/native), else numpy
   - "auto":  native if available for small host-side work, else numpy
@@ -58,7 +61,7 @@ class ReedSolomon:
             raise ValueError("bad shard counts")
         if data_shards + parity_shards > 256:
             raise ValueError("too many shards for GF(2^8)")
-        if backend not in ("auto", "jax", "numpy", "native"):
+        if backend not in ("auto", "jax", "numpy", "native", "pallas"):
             raise ValueError(f"unknown RS backend {backend!r}")
         self.data_shards = data_shards
         self.parity_shards = parity_shards
@@ -97,6 +100,9 @@ class ReedSolomon:
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_kernel
             return rs_kernel.apply_matrix(matrix, shards)
+        if self.backend == "pallas":
+            from seaweedfs_tpu.ops import rs_pallas
+            return rs_pallas.apply_matrix(matrix, shards)
         if self.backend in ("auto", "native"):
             from seaweedfs_tpu.native import rs_native
             if rs_native.available():
