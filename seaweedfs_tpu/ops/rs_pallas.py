@@ -43,9 +43,11 @@ TILE = 32768
 
 
 def _kernel(o8: int, s: int, m2_ref, data_ref, out_ref):
-    """One lane tile: expand -> 8 matmuls -> pack.
+    """One lane tile: expand -> one K=s*8 matmul -> pack.
 
-    m2_ref:   [8, o8, s] int8 — per-bit-plane GF(2) matrices
+    m2_ref:   [o8, s*8] int8 — GF(2) bit-matrix, columns plane-major
+              (bit j of shard d at column j*s + d, matching the
+              concatenated bit-plane layout built below)
     data_ref: [s, T] uint8
     out_ref:  [o8 // 8, T] uint8
     """
@@ -85,6 +87,8 @@ def _m2_planes(matrix_bytes: bytes, o: int, s: int) -> np.ndarray:
 def _build_call(o: int, s: int, n: int, interpret: bool):
     o8 = o * 8
     tile = min(TILE, n)
+    if n % tile != 0:
+        raise ValueError(f"lane count {n} not a tile multiple")
     grid = (n // tile,)
 
     kernel = functools.partial(_kernel, o8, s)
@@ -116,8 +120,10 @@ def gf_linear_pallas(matrix: np.ndarray, data, *,
                      interpret: bool = False) -> jax.Array:
     """Apply GF(2^8) matrix [O, S] to data [S, N] uint8 -> [O, N].
 
-    N must be a multiple of 128 (lane tiling); callers pad (the slab
-    dispatcher in rs_kernel already buckets to powers of two >= 64K).
+    N must be a multiple of 128 (lane tiling) and either <= TILE or a
+    multiple of TILE — apply_matrix below slabs arbitrary sizes into
+    those shapes (bounded distinct compiles, like rs_kernel's slab
+    dispatcher; compiles are slow over the remote tunnel).
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     o, s = matrix.shape
@@ -132,29 +138,40 @@ def gf_linear_pallas(matrix: np.ndarray, data, *,
 
 def apply_matrix(matrix: np.ndarray, shards) -> np.ndarray:
     """Host-friendly codec entry mirroring rs_kernel.apply_matrix:
-    flattens batch dims into lanes, pads lanes to a 128 multiple,
-    dispatches the Pallas kernel (interpret mode off-TPU)."""
+    flattens batch dims into lanes and dispatches the Pallas kernel in
+    TILE-sized slabs, with the tail padded up to a power-of-two bucket
+    — GF maps send 0 to 0, so padding trims cleanly, and the distinct
+    compiled shapes stay bounded. Interpret mode off-TPU."""
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     shards = np.asarray(shards, dtype=np.uint8)
     batch_shape = shards.shape[:-2]
     s, lanes = shards.shape[-2:]
     o = matrix.shape[0]
-    if lanes == 0:
-        return np.zeros(batch_shape + (o, 0), dtype=np.uint8)
     if batch_shape:
         flat = np.ascontiguousarray(np.moveaxis(
             shards.reshape((-1, s, lanes)), 1, 0)).reshape(s, -1)
     else:
         flat = shards
     n = flat.shape[1]
-    padded_n = -(-n // 128) * 128
-    if padded_n != n:
-        padded = np.zeros((s, padded_n), dtype=np.uint8)
-        padded[:, :n] = flat
-        flat = padded
+    if n == 0:
+        return np.zeros(batch_shape + (o, lanes), dtype=np.uint8)
     interpret = jax.default_backend() not in ("tpu",)
-    out = np.asarray(gf_linear_pallas(matrix, flat,
-                                      interpret=interpret))[:, :n]
+    out = np.empty((o, n), dtype=np.uint8)
+    pos = 0
+    while pos < n:
+        want = min(TILE, n - pos)
+        chunk = flat[:, pos:pos + want]
+        if want < TILE:
+            bucket = 128
+            while bucket < want:
+                bucket <<= 1
+            padded = np.zeros((s, bucket), dtype=np.uint8)
+            padded[:, :want] = chunk
+            chunk = padded
+        res = np.asarray(gf_linear_pallas(matrix, chunk,
+                                          interpret=interpret))
+        out[:, pos:pos + want] = res[:, :want]
+        pos += want
     if batch_shape:
         out = np.moveaxis(out.reshape(o, -1, lanes), 0, 1).reshape(
             batch_shape + (o, lanes))

@@ -124,9 +124,15 @@ def test_pallas_backend_byte_equality():
     rng = np.random.default_rng(5)
     ref = ReedSolomon(backend="numpy")
     pal = ReedSolomon(backend="pallas")
-    for lanes in (128, 1000, 4096 + 17):
+    from seaweedfs_tpu.ops import rs_pallas
+    lane_cases = (128, 1000, 4096 + 17,
+                  rs_pallas.TILE + 257)   # crosses a tile boundary
+    for lanes in lane_cases:
         data = rng.integers(0, 256, size=(10, lanes), dtype=np.uint8)
         np.testing.assert_array_equal(pal.encode(data), ref.encode(data))
+    # empty batch round-trips without dispatch
+    empty = np.zeros((0, 10, 256), dtype=np.uint8)
+    assert pal.encode(empty).shape == (0, 4, 256)
     data = rng.integers(0, 256, size=(10, 777), dtype=np.uint8)
     full = ref.encode_all(data)
     present = [0, 2, 3, 4, 6, 7, 8, 9, 10, 12]
