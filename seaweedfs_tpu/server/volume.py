@@ -713,6 +713,20 @@ class VolumeServer:
             self._hb_call.cancel()
         return volume_server_pb2.VolumeServerLeaveResponse()
 
+    def VolumeConfigure(self, request, context):
+        """Rewrite a volume's replica placement in its superblock
+        (reference server/volume_grpc_admin.go:104)."""
+        try:
+            found = self.store.configure_volume(request.volume_id,
+                                                request.replication)
+        except (ValueError, VolumeError) as e:
+            return volume_server_pb2.VolumeConfigureResponse(error=str(e))
+        if not found:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        self.trigger_heartbeat()
+        return volume_server_pb2.VolumeConfigureResponse()
+
     # -- needle data ops (shared by HTTP and gRPC paths) -----------------------
 
     def _read_needle(self, vid: int, n: Needle) -> Needle:

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from seaweedfs_tpu.ec.shard_bits import ShardBits
 from seaweedfs_tpu.pb import master_pb2, volume_server_pb2
 from seaweedfs_tpu.shell import command
 from seaweedfs_tpu.shell.command_env import CommandEnv
@@ -179,6 +180,236 @@ def volume_fix_replication(env: CommandEnv, argv: List[str], out) -> None:
             out.write("all volumes sufficiently replicated\n")
     finally:
         env.release_lock()
+
+
+def plan_server_evacuation(
+        counts: Dict[str, List[int]], max_counts: Dict[str, int],
+        server: str) -> Tuple[List[VolumeMove], List[int]]:
+    """Plan moving every volume off `server`. Each volume goes to the
+    least-loaded other node not already holding a replica of it
+    (reference command_volume_server_evacuate.go moveAwayOneNormalVolume).
+    Returns (moves, unmoveable_vids)."""
+    if server not in counts:
+        raise ValueError(f"{server} is not in this cluster")
+    held = {u: list(v) for u, v in counts.items()}
+    moves: List[VolumeMove] = []
+    stuck: List[int] = []
+    others = [u for u in counts if u != server]
+    for vid in list(held[server]):
+        candidates = [u for u in others
+                      if vid not in held[u]
+                      and len(held[u]) < max_counts.get(u, 8)]
+        if not candidates:
+            stuck.append(vid)
+            continue
+        dst = min(candidates,
+                  key=lambda u: len(held[u]) / max(1, max_counts.get(u, 8)))
+        held[server].remove(vid)
+        held[dst].append(vid)
+        moves.append(VolumeMove(vid, server, dst))
+    return moves, stuck
+
+
+def plan_ec_evacuation(nodes, server: str):
+    """Plan moving every EC shard off `server`: each shard to the other
+    node with the fewest total shards that doesn't hold that shard and
+    still has free slots (reference command_volume_server_evacuate.go
+    evacuateEcVolumes). Moves are grouped per (vid, dst) so the
+    executor copies the .ecx once and batches the 4 lifecycle RPCs."""
+    from seaweedfs_tpu.shell.ec_common import ShardMove
+    by_url = {n.url: n for n in nodes}
+    if server not in by_url:
+        return [], []
+    this, others = by_url[server], [n for n in nodes if n.url != server]
+    loads = {n.url: n.shard_count() for n in others}
+    room = {n.url: max(n.free_slots, 0) for n in others}
+    grouped: Dict[Tuple[int, str], List[int]] = {}
+    stuck = []
+    for vid, bits in sorted(this.shards.items()):
+        for sid in bits.shard_ids:
+            candidates = [n for n in others
+                          if room[n.url] > 0
+                          and sid not in n.shards.get(vid, ShardBits(0)
+                                                      ).shard_ids]
+            if not candidates:
+                stuck.append((vid, sid))
+                continue
+            dst = min(candidates, key=lambda n: loads[n.url])
+            loads[dst.url] += 1
+            room[dst.url] -= 1
+            grouped.setdefault((vid, dst.url), []).append(sid)
+    moves = [ShardMove(vid, tuple(sids), server, dst)
+             for (vid, dst), sids in sorted(grouped.items())]
+    return moves, stuck
+
+
+@command("volume.copy", "copy a volume from one server to another")
+def volume_copy(env: CommandEnv, argv: List[str], out) -> None:
+    """Reference: weed/shell/command_volume_copy.go — a plain VolumeCopy
+    to the target (the source keeps its replica; use volume.move to
+    transfer ownership)."""
+    p = argparse.ArgumentParser(prog="volume.copy")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-source", required=True)
+    p.add_argument("-target", required=True)
+    args = p.parse_args(argv)
+    if args.source == args.target:
+        raise ValueError("source and target are the same node")
+    env.acquire_lock()
+    try:
+        # Fence writes on the source for the duration of the pull: a
+        # needle landing mid-copy would be missing from the new replica
+        # while the master serves both locations (same reasoning as
+        # _move_volume above). A volume that was already readonly
+        # (sealed, tiered) stays that way afterwards.
+        was_readonly = any(
+            vi.read_only
+            for _, _, dn in env.data_nodes(env.topology())
+            if dn.id == args.source
+            for vi in dn.volume_infos if vi.id == args.volumeId)
+        env.volume_server(args.source).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(
+                volume_id=args.volumeId))
+        try:
+            env.volume_server(args.target).VolumeCopy(
+                volume_server_pb2.VolumeCopyRequest(
+                    volume_id=args.volumeId,
+                    source_data_node=args.source))
+        finally:
+            if not was_readonly:
+                env.volume_server(args.source).VolumeMarkWritable(
+                    volume_server_pb2.VolumeMarkWritableRequest(
+                        volume_id=args.volumeId))
+        out.write(f"volume {args.volumeId}: copied {args.source} -> "
+                  f"{args.target}\n")
+    finally:
+        env.release_lock()
+
+
+@command("volume.configure.replication",
+         "change a volume's replication value")
+def volume_configure_replication(env: CommandEnv, argv: List[str],
+                                 out) -> None:
+    """Reference: weed/shell/command_volume_configure_replication.go —
+    rewrite the superblock on every replica whose placement differs;
+    follow with volume.fix.replication to actually create the copies."""
+    p = argparse.ArgumentParser(prog="volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-replication", required=True)
+    args = p.parse_args(argv)
+    want = ReplicaPlacement.parse(args.replication).to_byte()
+    env.acquire_lock()
+    try:
+        touched = 0
+        for _, _, dn in env.data_nodes(env.topology()):
+            for vi in dn.volume_infos:
+                if vi.id != args.volumeId or vi.replica_placement == want:
+                    continue
+                resp = env.volume_server(dn.id).VolumeConfigure(
+                    volume_server_pb2.VolumeConfigureRequest(
+                        volume_id=args.volumeId,
+                        replication=args.replication))
+                if resp.error:
+                    raise RuntimeError(f"{dn.id}: {resp.error}")
+                out.write(f"volume {args.volumeId}: replication -> "
+                          f"{args.replication} on {dn.id}\n")
+                touched += 1
+        if not touched:
+            out.write(f"volume {args.volumeId}: nothing to change\n")
+    finally:
+        env.release_lock()
+
+
+@command("volumeServer.evacuate", "move all data off a volume server")
+def volume_server_evacuate(env: CommandEnv, argv: List[str], out) -> None:
+    """Reference: weed/shell/command_volume_server_evacuate.go — move
+    every normal volume and EC shard to other servers, typically before
+    a shutdown or upgrade."""
+    p = argparse.ArgumentParser(prog="volumeServer.evacuate")
+    p.add_argument("-node", required=True, help="<host:port> to drain")
+    p.add_argument("-skipNonMoveable", action="store_true")
+    p.add_argument("-force", action="store_true",
+                   help="actually apply the changes")
+    args = p.parse_args(argv)
+
+    def plan():
+        topo = env.topology()
+        counts: Dict[str, List[int]] = {}
+        max_counts: Dict[str, int] = {}
+        for _, _, dn in env.data_nodes(topo):
+            counts[dn.id] = [vi.id for vi in dn.volume_infos]
+            max_counts[dn.id] = int(dn.max_volume_count)
+        moves, stuck = plan_server_evacuation(counts, max_counts,
+                                              args.node)
+        ec_moves, ec_stuck = plan_ec_evacuation(
+            env.collect_ec_nodes(topo), args.node)
+        if (stuck or ec_stuck) and not args.skipNonMoveable:
+            items = [str(v) for v in stuck] + \
+                [f"{vid}.{sid}" for vid, sid in ec_stuck]
+            raise RuntimeError(
+                f"no destination for: {', '.join(items)} "
+                f"(use -skipNonMoveable to move the rest)")
+        return topo, moves, stuck, ec_moves, ec_stuck
+
+    if not args.force:
+        _, moves, stuck, ec_moves, ec_stuck = plan()
+        for mv in moves:
+            out.write(f"would move volume {mv.vid} {mv.src} -> {mv.dst}\n")
+        for mv in ec_moves:
+            out.write(f"would move shards {list(mv.shard_ids)} of "
+                      f"volume {mv.vid} {mv.src} -> {mv.dst}\n")
+        out.write("dry run; add -force to execute\n")
+        return
+    env.acquire_lock()
+    try:
+        # plan under the lock: another admin's move between snapshot and
+        # execution would make VolumeCopy abort mid-drain
+        topo, moves, stuck, ec_moves, ec_stuck = plan()
+        for mv in moves:
+            _move_volume(env, mv, out)
+        ec_collections = {}
+        for _, _, dn in env.data_nodes(topo):
+            for e in dn.ec_shard_infos:
+                ec_collections[e.id] = e.collection
+        for mv in ec_moves:
+            collection = ec_collections.get(mv.vid, "")
+            env.volume_server(mv.dst).VolumeEcShardsCopy(
+                volume_server_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids), copy_ecx_file=True,
+                    copy_ecj_file=True, source_data_node=mv.src))
+            env.volume_server(mv.dst).VolumeEcShardsMount(
+                volume_server_pb2.VolumeEcShardsMountRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids)))
+            env.volume_server(mv.src).VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=mv.vid, shard_ids=list(mv.shard_ids)))
+            env.volume_server(mv.src).VolumeEcShardsDelete(
+                volume_server_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=mv.vid, collection=collection,
+                    shard_ids=list(mv.shard_ids)))
+            out.write(f"volume {mv.vid}: moved shards "
+                      f"{list(mv.shard_ids)} {mv.src} -> {mv.dst}\n")
+        for vid in stuck:
+            out.write(f"skipped non-moveable volume {vid}\n")
+        for vid, sid in ec_stuck:
+            out.write(f"skipped non-moveable shard {vid}.{sid}\n")
+    finally:
+        env.release_lock()
+
+
+@command("volumeServer.leave", "ask a volume server to leave the cluster")
+def volume_server_leave(env: CommandEnv, argv: List[str], out) -> None:
+    """Reference: weed/shell/command_volume_server_leave.go — the server
+    stops heartbeating so the master forgets it; its process stays up
+    until stopped by the operator."""
+    p = argparse.ArgumentParser(prog="volumeServer.leave")
+    p.add_argument("-node", required=True, help="<host:port> to remove")
+    args = p.parse_args(argv)
+    env.volume_server(args.node).VolumeServerLeave(
+        volume_server_pb2.VolumeServerLeaveRequest())
+    out.write(f"{args.node}: asked to leave\n")
 
 
 @command("volume.vacuum", "compact volumes above the garbage threshold")

@@ -105,6 +105,16 @@ class Store:
         v.read_only = False
         return True
 
+    def configure_volume(self, vid: int, replication: str) -> bool:
+        """Change a volume's replica placement on disk (reference
+        store.go:431); returns False when the volume isn't here."""
+        from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.configure_replication(ReplicaPlacement.parse(replication))
+        return True
+
     # -- data ops ------------------------------------------------------------
 
     def write_needle(self, vid: int, n: Needle, fsync: bool = False):

@@ -92,6 +92,18 @@ def commit_compact(v: Volume, state: CompactState) -> None:
     with v._lock:
         v.sync()
         _makeup_diff(v, state)
+        # Re-stamp the shadow superblock from the LIVE one (keeping the
+        # bumped revision): volume.configure.replication may have changed
+        # the replica placement while the compact scan ran, and renaming
+        # a stale .cpd over the .dat would silently revert it.
+        with open(state.cpd_path, "r+b") as cpd:
+            shadow = SuperBlock.from_bytes(cpd.read(8))
+            cpd.seek(0)
+            cpd.write(SuperBlock(
+                version=shadow.version,
+                replica_placement=v.super_block.replica_placement,
+                ttl=v.super_block.ttl,
+                compaction_revision=shadow.compaction_revision).to_bytes())
         for p in (state.cpd_path, state.cpx_path):
             fd = os.open(p, os.O_RDONLY)
             try:

@@ -552,6 +552,23 @@ class Volume:
 
     # -- stats / lifecycle ---------------------------------------------------
 
+    def configure_replication(self, rp: ReplicaPlacement) -> None:
+        """Rewrite the superblock's replica-placement byte in place
+        (reference store.go:431 ConfigureVolume → super_block byte 1).
+        Remote (cloud-tiered) volumes are sealed; their superblock lives
+        in the object store and is not rewritten."""
+        with self._lock:
+            if self._dat.is_remote:
+                raise VolumeError(
+                    f"volume {self.id} is cloud-tiered; download it first")
+            self.super_block = SuperBlock(
+                version=self.super_block.version,
+                replica_placement=rp,
+                ttl=self.super_block.ttl,
+                compaction_revision=self.super_block.compaction_revision)
+            self._dat.write_at(self.super_block.to_bytes(), 0)
+            self._dat.sync()
+
     @property
     def content_size(self) -> int:
         return self._dat.size()

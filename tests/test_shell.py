@@ -270,3 +270,139 @@ def test_volume_move_fences_writes(cluster, shell):
     # destination must accept writes again
     dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
     assert not dst_vs.store.find_volume(vid).read_only
+
+
+# -- evacuate / leave / copy / configure.replication ---------------------------
+
+
+def test_plan_server_evacuation():
+    from seaweedfs_tpu.shell.command_volume import plan_server_evacuation
+    counts = {"a:1": [1, 2, 3], "b:1": [4], "c:1": [2]}
+    maxes = {"a:1": 10, "b:1": 10, "c:1": 10}
+    moves, stuck = plan_server_evacuation(counts, maxes, "a:1")
+    assert not stuck
+    assert {mv.vid for mv in moves} == {1, 2, 3}
+    for mv in moves:
+        assert mv.src == "a:1" and mv.dst in ("b:1", "c:1")
+    # volume 2 already lives on c -> it must land on b
+    assert next(mv for mv in moves if mv.vid == 2).dst == "b:1"
+
+
+def test_plan_server_evacuation_stuck_when_no_room():
+    from seaweedfs_tpu.shell.command_volume import plan_server_evacuation
+    # every other node already holds vid 9
+    counts = {"a:1": [9], "b:1": [9]}
+    moves, stuck = plan_server_evacuation(counts, {"a:1": 10, "b:1": 10},
+                                          "a:1")
+    assert moves == [] and stuck == [9]
+
+
+def test_plan_ec_evacuation():
+    from seaweedfs_tpu.shell.command_volume import plan_ec_evacuation
+    nodes = [
+        EcNode("a:1", 5, {7: ShardBits.of(0, 1, 2)}),
+        EcNode("b:1", 5, {7: ShardBits.of(0)}),
+        EcNode("c:1", 5, {}),
+    ]
+    moves, stuck = plan_ec_evacuation(nodes, "a:1")
+    assert not stuck
+    moved = {sid for mv in moves for sid in mv.shard_ids}
+    assert moved == {0, 1, 2}
+    # shard 0 already on b -> must land on c
+    dst_of = {sid: mv.dst for mv in moves for sid in mv.shard_ids}
+    assert dst_of[0] == "c:1"
+    # moves are grouped: at most one ShardMove per (vid, dst)
+    assert len(moves) == len({(mv.vid, mv.dst) for mv in moves})
+
+
+def test_plan_ec_evacuation_respects_free_slots():
+    from seaweedfs_tpu.shell.command_volume import plan_ec_evacuation
+    nodes = [
+        EcNode("a:1", 5, {7: ShardBits.of(0, 1)}),
+        EcNode("b:1", 1, {}),   # room for one shard only
+        EcNode("c:1", 0, {}),   # full
+    ]
+    moves, stuck = plan_ec_evacuation(nodes, "a:1")
+    assert sum(len(mv.shard_ids) for mv in moves) == 1
+    assert all(mv.dst == "b:1" for mv in moves)
+    assert stuck == [(7, 1)]
+
+
+def test_volume_copy_creates_replica(cluster, shell):
+    from seaweedfs_tpu.operation import operations
+    fid = cluster.upload(b"copy me")
+    vid = parse_fid(fid).volume_id
+    src = operations.lookup(cluster.master.url, vid)[0]
+    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    shell.run_command(f"volume.copy -volumeId={vid} "
+                      f"-source={src} -target={dst}")
+    cluster.wait_for(
+        lambda: set(operations.lookup(cluster.master.url, vid)) ==
+        {src, dst}, what="master sees both replicas")
+    dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
+    n = dst_vs.store.read_needle(vid, _needle_for(fid))
+    assert bytes(n.data) == b"copy me"
+
+
+def _needle_for(fid):
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    from seaweedfs_tpu.storage.needle import Needle
+    f = parse_fid(fid)
+    return Needle(id=f.key, cookie=f.cookie)
+
+
+def test_volume_configure_replication(cluster, shell):
+    fid = cluster.upload(b"reconf")
+    vid = parse_fid(fid).volume_id
+    out = shell.run_command(
+        f"volume.configure.replication -volumeId={vid} -replication=001")
+    assert "replication -> 001" in out
+
+    def placement_seen():
+        for _, _, dn in _shell_env(shell).data_nodes(
+                _shell_env(shell).topology()):
+            for vi in dn.volume_infos:
+                if vi.id == vid:
+                    return vi.replica_placement == 1
+        return False
+    cluster.wait_for(placement_seen, what="new placement in heartbeat")
+    # on-disk superblock really changed
+    vs = next(v for v in cluster.volume_servers
+              if v.store.find_volume(vid) is not None)
+    assert str(vs.store.find_volume(vid).replica_placement) == "001"
+    # idempotent second run
+    out = shell.run_command(
+        f"volume.configure.replication -volumeId={vid} -replication=001")
+    assert "nothing to change" in out
+
+
+def _shell_env(shell):
+    return shell.env
+
+
+def test_volume_server_evacuate_and_leave(tmp_path):
+    from seaweedfs_tpu.operation import operations
+    c = Cluster(tmp_path, n_volume_servers=3)
+    try:
+        sh = Shell(c.master.url)
+        fids = [c.upload(os.urandom(512)) for _ in range(6)]
+        victim = operations.lookup(
+            c.master.url, parse_fid(fids[0]).volume_id)[0]
+        out = sh.run_command(f"volumeServer.evacuate -node={victim}")
+        assert "dry run" in out
+        out = sh.run_command(
+            f"volumeServer.evacuate -node={victim} -skipNonMoveable -force")
+        vs = next(v for v in c.volume_servers if v.url == victim)
+
+        def drained():
+            hb = vs.store.collect_heartbeat()
+            return not hb["volumes"] and not hb["ec_shards"]
+        c.wait_for(drained, what="victim drained")
+        for fid in fids:  # every blob still readable
+            assert operations.download(c.master.url, fid)
+        sh.run_command(f"volumeServer.leave -node={victim}")
+        c.wait_for(
+            lambda: victim not in c.master.topo.nodes(),
+            what="master forgets the node")
+    finally:
+        c.stop()

@@ -143,3 +143,27 @@ def test_recover_compaction_rolls_forward(tmp_path):
     for n in needles[3:]:
         assert v2.read_needle(Needle(id=n.id, cookie=n.cookie)).data == n.data
     v2.close()
+
+
+def test_commit_preserves_replication_changed_mid_compaction(vol):
+    """volume.configure.replication racing a vacuum must survive the
+    commit (regression: the .cpd carried the superblock snapshotted at
+    compact start and silently reverted the change on rename)."""
+    from seaweedfs_tpu.storage.superblock import ReplicaPlacement
+    for i in range(10):
+        vol.write_needle(make_needle(i))
+    for i in range(5):
+        vol.delete_needle(make_needle(i))
+    state = compact(vol)
+    old_rev = vol.super_block.compaction_revision
+    vol.configure_replication(ReplicaPlacement.parse("010"))
+    commit_compact(vol, state)
+    assert str(vol.replica_placement) == "010"
+    assert vol.super_block.compaction_revision == old_rev + 1
+    # and it survives a reload from disk
+    vol.close()
+    v2 = Volume(vol.dir, "", vol.id, create_if_missing=False)
+    try:
+        assert str(v2.replica_placement) == "010"
+    finally:
+        v2.close()
