@@ -351,6 +351,22 @@ class Iam:
         return ident
 
 
+def iam_from_dict(cfg: dict) -> Iam:
+    """Build an Iam from the s3.configure JSON document
+    ({"identities": [{"name", "credentials": [{"accessKey",
+    "secretKey"}], "actions": [...]}]}) — the wire format the shell
+    stores at /etc/iam/identity.json (reference
+    iam_pb.S3ApiConfiguration)."""
+    idents = []
+    for ident in cfg.get("identities", []) or []:
+        creds = [Credential(c.get("accessKey", ""), c.get("secretKey", ""))
+                 for c in ident.get("credentials", [])]
+        idents.append(Identity(name=ident.get("name", ""),
+                               credentials=creds,
+                               actions=list(ident.get("actions", []))))
+    return Iam(idents)
+
+
 def iam_from_toml(cfg) -> Iam:
     """Build an Iam from the [s3] section of a config
     (identities = [{name, access_key, secret_key, actions}, ...])."""

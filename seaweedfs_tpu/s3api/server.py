@@ -28,6 +28,8 @@ from seaweedfs_tpu.s3api.auth import (ACTION_ADMIN, ACTION_LIST,
                                       ACTION_WRITE, Iam, S3AuthError)
 
 BUCKETS_DIR = "/buckets"
+IAM_CONF_DIR = "/etc/iam"           # reference filer.IamConfigDirecotry
+IAM_IDENTITY_FILE = "identity.json"  # reference filer.IamIdentityFile
 MULTIPART_DIR = ".uploads"          # hidden dir inside the bucket
 S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 TAG_PREFIX = "x-amz-tag-"
@@ -45,6 +47,10 @@ class S3ApiServer:
         self.iam = iam or Iam()
         self._http_server = None
         self._http_thread = None
+        self._iam_watcher = None
+        self._iam_call = None
+        self._iam_lock = threading.Lock()
+        self._stopping = False
 
     @property
     def url(self) -> str:
@@ -57,13 +63,77 @@ class S3ApiServer:
             target=self._http_server.serve_forever,
             name=f"s3-http-{self.port}", daemon=True)
         self._http_thread.start()
+        self._reload_dynamic_iam()
+        self._iam_watcher = threading.Thread(
+            target=self._watch_iam, name=f"s3-iam-{self.port}",
+            daemon=True)
+        self._iam_watcher.start()
         log.info("s3 gateway %s:%d started (filer=%s)",
                  self.ip, self.port, self.filer_url)
 
     def stop(self) -> None:
+        self._stopping = True
+        with self._iam_lock:
+            if self._iam_call is not None:
+                self._iam_call.cancel()
         if self._http_server:
             self._http_server.shutdown()
             self._http_server.server_close()
+
+    # -- dynamic identities (s3.configure) ------------------------------------
+
+    def _reload_dynamic_iam(self) -> None:
+        """Load identities written by the shell's s3.configure to
+        /etc/iam/identity.json in the filer; a static -config file is
+        the fallback when no dynamic config exists (reference
+        auth_credentials.go loads the same path)."""
+        import json
+        from seaweedfs_tpu.s3api.auth import iam_from_dict
+        path = f"{IAM_CONF_DIR}/{IAM_IDENTITY_FILE}"
+        try:
+            status, body, _ = self.filer_get(path)
+        except Exception:
+            return
+        if status != 200 or not body:
+            return
+        try:
+            self.iam = iam_from_dict(json.loads(body))
+            log.info("s3 iam reloaded: %d identities",
+                     len(self.iam.identities))
+        except (ValueError, KeyError) as e:
+            log.warning("s3 iam config unparseable, keeping old: %s", e)
+
+    def _watch_iam(self) -> None:
+        """Tail the filer metadata log for /etc/iam/ changes so
+        s3.configure -apply takes effect live."""
+        first = True
+        while not self._stopping:
+            try:
+                if not first:
+                    # catch up on anything written while the stream was
+                    # down: the new subscription starts at `now`, so a
+                    # change made during the gap would otherwise be
+                    # missed forever
+                    self._reload_dynamic_iam()
+                first = False
+                call = self.stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name=f"s3-iam-{self.port}",
+                        path_prefix=IAM_CONF_DIR + "/",
+                        since_ns=time.time_ns()))
+                with self._iam_lock:
+                    if self._stopping:
+                        call.cancel()
+                        return
+                    self._iam_call = call
+                for _rec in call:
+                    if self._stopping:
+                        return
+                    self._reload_dynamic_iam()
+            except Exception:
+                if self._stopping:
+                    return
+                time.sleep(0.5)
 
     # -- filer plumbing -------------------------------------------------------
 

@@ -31,6 +31,7 @@ def command(name: str, help_text: str = ""):
 from seaweedfs_tpu.shell import command_ec  # noqa: E402,F401
 from seaweedfs_tpu.shell import command_fs  # noqa: E402,F401
 from seaweedfs_tpu.shell import command_misc  # noqa: E402,F401
+from seaweedfs_tpu.shell import command_s3  # noqa: E402,F401
 from seaweedfs_tpu.shell import command_volume  # noqa: E402,F401
 
 

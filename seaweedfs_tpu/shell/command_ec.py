@@ -226,6 +226,32 @@ def _ec_collections(env: CommandEnv) -> Dict[int, str]:
     return out
 
 
+def apply_shard_move(env: CommandEnv, mv, collection: str, out) -> None:
+    """Execute one planned ShardMove: copy (with .ecx/.ecj) to the
+    destination, mount there, then unmount+delete at the source — the
+    crash-safe ordering the reference uses everywhere shards travel
+    (command_ec_balance.go/_evacuate: the shard exists in two places
+    until the destination serves it)."""
+    env.volume_server(mv.dst).VolumeEcShardsCopy(
+        volume_server_pb2.VolumeEcShardsCopyRequest(
+            volume_id=mv.vid, collection=collection,
+            shard_ids=list(mv.shard_ids), copy_ecx_file=True,
+            copy_ecj_file=True, source_data_node=mv.src))
+    env.volume_server(mv.dst).VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=mv.vid, collection=collection,
+            shard_ids=list(mv.shard_ids)))
+    env.volume_server(mv.src).VolumeEcShardsUnmount(
+        volume_server_pb2.VolumeEcShardsUnmountRequest(
+            volume_id=mv.vid, shard_ids=list(mv.shard_ids)))
+    env.volume_server(mv.src).VolumeEcShardsDelete(
+        volume_server_pb2.VolumeEcShardsDeleteRequest(
+            volume_id=mv.vid, collection=collection,
+            shard_ids=list(mv.shard_ids)))
+    out.write(f"volume {mv.vid}: moved shards "
+              f"{list(mv.shard_ids)} {mv.src} -> {mv.dst}\n")
+
+
 @command("ec.balance", "dedupe and spread EC shards evenly over nodes")
 def ec_balance(env: CommandEnv, argv: List[str], out) -> None:
     p = argparse.ArgumentParser(prog="ec.balance")
@@ -259,25 +285,7 @@ def ec_balance(env: CommandEnv, argv: List[str], out) -> None:
                       f"from {url}\n")
         nodes = env.collect_ec_nodes()
         for mv in ec_common.plan_balance(nodes):
-            collection = collections.get(mv.vid, "")
-            env.volume_server(mv.dst).VolumeEcShardsCopy(
-                volume_server_pb2.VolumeEcShardsCopyRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids), copy_ecx_file=True,
-                    copy_ecj_file=True, source_data_node=mv.src))
-            env.volume_server(mv.dst).VolumeEcShardsMount(
-                volume_server_pb2.VolumeEcShardsMountRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids)))
-            env.volume_server(mv.src).VolumeEcShardsUnmount(
-                volume_server_pb2.VolumeEcShardsUnmountRequest(
-                    volume_id=mv.vid, shard_ids=list(mv.shard_ids)))
-            env.volume_server(mv.src).VolumeEcShardsDelete(
-                volume_server_pb2.VolumeEcShardsDeleteRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids)))
-            out.write(f"volume {mv.vid}: moved shards "
-                      f"{list(mv.shard_ids)} {mv.src} -> {mv.dst}\n")
+            apply_shard_move(env, mv, collections.get(mv.vid, ""), out)
     finally:
         env.release_lock()
 

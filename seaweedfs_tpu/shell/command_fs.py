@@ -256,3 +256,51 @@ def fs_meta_load(env, argv, out):
                 n += 1
     print(f"loaded {n} entries from {args[0]}"
           + (f" ({errors} errors)" if errors else ""), file=out)
+
+
+@command("fs.configure", "add/view path-specific filer rules; -apply saves")
+def fs_configure(env, argv, out):
+    """Read-modify-write the filer's path-config document
+    (/etc/seaweedfs/filer.conf): per-prefix collection / replication /
+    ttl / fsync rules the filer applies to new writes. Without flags it
+    prints the current rules. Reference:
+    weed/shell/command_fs_configure.go."""
+    import argparse
+    from seaweedfs_tpu.filer import http_client
+    from seaweedfs_tpu.filer.filer_conf import (FILER_CONF_PATH, FilerConf,
+                                                PathConf)
+    p = argparse.ArgumentParser(prog="fs.configure")
+    p.add_argument("-locationPrefix", default="")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-ttl", default="")
+    p.add_argument("-fsync", action="store_true")
+    p.add_argument("-delete", action="store_true")
+    p.add_argument("-apply", action="store_true")
+    args = p.parse_args(argv)
+
+    try:
+        status, body, _ = http_client.get(env.filer_url, FILER_CONF_PATH)
+        conf = FilerConf.from_bytes(body) if status == 200 else FilerConf()
+    except Exception:
+        conf = FilerConf()
+
+    if args.locationPrefix:
+        rules = [r for r in conf.rules
+                 if r.location_prefix != args.locationPrefix]
+        if not args.delete:
+            rules.append(PathConf(
+                location_prefix=args.locationPrefix,
+                collection=args.collection,
+                replication=args.replication,
+                ttl=args.ttl, fsync=args.fsync))
+        conf = FilerConf(rules)
+
+    blob = conf.to_bytes()
+    out.write(blob.decode() + "\n")
+    if args.apply:
+        http_client.put(env.filer_url, FILER_CONF_PATH, blob,
+                        mime="application/json")
+        out.write("applied\n")
+    elif args.locationPrefix:
+        out.write("use -apply to save\n")

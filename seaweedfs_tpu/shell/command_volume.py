@@ -113,17 +113,20 @@ def volume_balance(env: CommandEnv, argv: List[str], out) -> None:
                     or vi.collection == args.collection]
             counts[dn.id] = vids
             max_counts[dn.id] = int(dn.max_volume_count)
+        readonly = _readonly_vids(env, topo)
         for mv in plan_volume_balance(counts, max_counts):
-            _move_volume(env, mv, out)
+            _move_volume(env, mv, out, was_readonly=mv.vid in readonly)
     finally:
         env.release_lock()
 
 
-def _move_volume(env: CommandEnv, mv: VolumeMove, out) -> None:
+def _move_volume(env: CommandEnv, mv: VolumeMove, out,
+                 was_readonly: bool = False) -> None:
     """freeze writes on src, copy to dst (pull from src), delete from
     src, unfreeze on dst — the reference's volume.move ordering
     (command_volume_move.go). Without the readonly fence a write landing
-    on src between copy and delete would be lost."""
+    on src between copy and delete would be lost. A volume that was
+    sealed before the move stays sealed on the destination."""
     env.volume_server(mv.src).VolumeMarkReadonly(
         volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=mv.vid))
     try:
@@ -131,15 +134,28 @@ def _move_volume(env: CommandEnv, mv: VolumeMove, out) -> None:
             volume_server_pb2.VolumeCopyRequest(
                 volume_id=mv.vid, source_data_node=mv.src))
     except Exception:
-        # copy failed: unfreeze the source so it keeps serving writes
-        env.volume_server(mv.src).VolumeMarkWritable(
-            volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
+        if not was_readonly:
+            # copy failed: unfreeze the source so it keeps serving writes
+            env.volume_server(mv.src).VolumeMarkWritable(
+                volume_server_pb2.VolumeMarkWritableRequest(
+                    volume_id=mv.vid))
         raise
     env.volume_server(mv.src).VolumeDelete(
         volume_server_pb2.VolumeDeleteRequest(volume_id=mv.vid))
-    env.volume_server(mv.dst).VolumeMarkWritable(
-        volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
+    if was_readonly:
+        env.volume_server(mv.dst).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=mv.vid))
+    else:
+        env.volume_server(mv.dst).VolumeMarkWritable(
+            volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
     out.write(f"volume {mv.vid}: moved {mv.src} -> {mv.dst}\n")
+
+
+def _readonly_vids(env: CommandEnv, topo=None) -> set:
+    """vids with any replica flagged readonly in the heartbeat view."""
+    topo = topo or env.topology()
+    return {vi.id for _, _, dn in env.data_nodes(topo)
+            for vi in dn.volume_infos if vi.read_only}
 
 
 @command("volume.move", "move one volume between servers")
@@ -152,7 +168,8 @@ def volume_move(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()
     try:
         _move_volume(env, VolumeMove(args.volumeId, args.source,
-                                     args.target), out)
+                                     args.target), out,
+                     was_readonly=args.volumeId in _readonly_vids(env))
     finally:
         env.release_lock()
 
@@ -364,33 +381,15 @@ def volume_server_evacuate(env: CommandEnv, argv: List[str], out) -> None:
     try:
         # plan under the lock: another admin's move between snapshot and
         # execution would make VolumeCopy abort mid-drain
+        from seaweedfs_tpu.shell.command_ec import (_ec_collections,
+                                                    apply_shard_move)
         topo, moves, stuck, ec_moves, ec_stuck = plan()
+        readonly = _readonly_vids(env, topo)
         for mv in moves:
-            _move_volume(env, mv, out)
-        ec_collections = {}
-        for _, _, dn in env.data_nodes(topo):
-            for e in dn.ec_shard_infos:
-                ec_collections[e.id] = e.collection
+            _move_volume(env, mv, out, was_readonly=mv.vid in readonly)
+        ec_collections = _ec_collections(env)
         for mv in ec_moves:
-            collection = ec_collections.get(mv.vid, "")
-            env.volume_server(mv.dst).VolumeEcShardsCopy(
-                volume_server_pb2.VolumeEcShardsCopyRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids), copy_ecx_file=True,
-                    copy_ecj_file=True, source_data_node=mv.src))
-            env.volume_server(mv.dst).VolumeEcShardsMount(
-                volume_server_pb2.VolumeEcShardsMountRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids)))
-            env.volume_server(mv.src).VolumeEcShardsUnmount(
-                volume_server_pb2.VolumeEcShardsUnmountRequest(
-                    volume_id=mv.vid, shard_ids=list(mv.shard_ids)))
-            env.volume_server(mv.src).VolumeEcShardsDelete(
-                volume_server_pb2.VolumeEcShardsDeleteRequest(
-                    volume_id=mv.vid, collection=collection,
-                    shard_ids=list(mv.shard_ids)))
-            out.write(f"volume {mv.vid}: moved shards "
-                      f"{list(mv.shard_ids)} {mv.src} -> {mv.dst}\n")
+            apply_shard_move(env, mv, ec_collections.get(mv.vid, ""), out)
         for vid in stuck:
             out.write(f"skipped non-moveable volume {vid}\n")
         for vid, sid in ec_stuck:

@@ -406,3 +406,27 @@ def test_volume_server_evacuate_and_leave(tmp_path):
             what="master forgets the node")
     finally:
         c.stop()
+
+
+def test_volume_move_preserves_readonly(cluster, shell):
+    """A sealed volume must stay sealed after volume.move (regression:
+    the destination was unconditionally marked writable)."""
+    from seaweedfs_tpu.operation import operations
+    fid = cluster.upload(b"sealed blob")
+    vid = parse_fid(fid).volume_id
+    src = operations.lookup(cluster.master.url, vid)[0]
+    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    shell.run_command(f"volume.mark -volumeId={vid} -readonly")
+
+    def seen_readonly():
+        for _, _, dn in shell.env.data_nodes(shell.env.topology()):
+            for vi in dn.volume_infos:
+                if vi.id == vid and vi.read_only:
+                    return True
+        return False
+    cluster.wait_for(seen_readonly, what="readonly visible in topology")
+    shell.run_command(f"volume.move -volumeId={vid} "
+                      f"-source={src} -target={dst}")
+    dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
+    assert dst_vs.store.find_volume(vid).read_only
+    assert operations.download(cluster.master.url, fid) == b"sealed blob"

@@ -1,0 +1,121 @@
+"""s3.* and fs.configure admin-shell commands over a real cluster,
+including the S3 gateway's live identity reload."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.filer import http_client
+from seaweedfs_tpu.s3api import S3ApiServer
+from seaweedfs_tpu.shell import Shell
+from tests.cluster_util import Cluster, free_port_pair
+from tests.test_s3 import ACCESS, SECRET, SigV4Client
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("shs3cluster"),
+                n_volume_servers=1, with_filer=True)
+    c.s3 = S3ApiServer(filer_url=c.filer.url, port=free_port_pair())
+    c.s3.start()
+    yield c
+    c.s3.stop()
+    c.stop()
+
+
+@pytest.fixture()
+def shell(cluster):
+    return Shell(cluster.master.url, filer_url=cluster.filer.url)
+
+
+def test_bucket_create_list_delete(cluster, shell):
+    out = shell.run_command("s3.bucket.create -name shelly "
+                            "-replication 000")
+    assert "created bucket shelly" in out
+    assert "shelly" in shell.run_command("s3.bucket.list")
+    # the dir exists in the namespace with collection = bucket name
+    e = shell.env.filer_entry("/buckets/shelly")
+    assert e is not None and e.is_directory
+    assert e.attributes.collection == "shelly"
+    # objects written there land in the bucket's collection; delete
+    # drops both namespace and collection
+    http_client.put(cluster.filer.url, "/buckets/shelly/x.txt", b"hi")
+    out = shell.run_command("s3.bucket.delete -name shelly")
+    assert "deleted bucket shelly" in out
+    assert "shelly" not in shell.run_command("s3.bucket.list")
+    assert shell.env.filer_entry("/buckets/shelly") is None
+
+
+def test_s3_configure_roundtrip_and_gateway_reload(cluster, shell):
+    # gateway starts with no identities -> anonymous allowed
+    urllib.request.urlopen(f"http://{cluster.s3.url}/", timeout=10).read()
+
+    out = shell.run_command(
+        f"s3.configure -user admin -access_key {ACCESS} "
+        f"-secret_key {SECRET} -actions Admin -apply")
+    assert "applied" in out
+    doc = json.loads(out.split("applied")[0])
+    assert doc["identities"][0]["name"] == "admin"
+    assert doc["identities"][0]["credentials"][0]["accessKey"] == ACCESS
+
+    # stored in the filer at the reference path
+    status, body, _ = http_client.get(cluster.filer.url,
+                                      "/etc/iam/identity.json")
+    assert status == 200 and json.loads(body)["identities"]
+
+    # the gateway reloads live: anonymous now rejected, signed works
+    cluster.wait_for(lambda: cluster.s3.iam.is_enabled,
+                     what="gateway iam reload")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"http://{cluster.s3.url}/", timeout=10)
+    assert ei.value.code == 403
+    with SigV4Client(cluster.s3.url).request("GET", "/") as r:
+        assert r.status == 200
+
+
+def test_s3_configure_edit_and_delete(cluster, shell):
+    shell.run_command(
+        "s3.configure -user bob -access_key BK -secret_key BS "
+        "-actions Read,Write -buckets b1 -apply")
+    doc = json.loads(
+        shell.run_command("s3.configure").rsplit("}", 1)[0] + "}")
+    bob = next(i for i in doc["identities"] if i["name"] == "bob")
+    assert set(bob["actions"]) == {"Read:b1", "Write:b1"}
+    # remove one action
+    shell.run_command(
+        "s3.configure -user bob -actions Write -buckets b1 -delete -apply")
+    doc = json.loads(
+        shell.run_command("s3.configure").rsplit("}", 1)[0] + "}")
+    bob = next(i for i in doc["identities"] if i["name"] == "bob")
+    assert bob["actions"] == ["Read:b1"]
+    # drop the whole user
+    shell.run_command("s3.configure -user bob -delete -apply")
+    doc = json.loads(
+        shell.run_command("s3.configure").rsplit("}", 1)[0] + "}")
+    assert not any(i["name"] == "bob" for i in doc["identities"])
+
+
+def test_s3_configure_rejects_unknown_action(shell):
+    from seaweedfs_tpu.shell import CommandError
+    with pytest.raises(CommandError, match="unknown action"):
+        shell.run_command("s3.configure -user x -actions Fly")
+
+
+def test_fs_configure_rule_applies_live(cluster, shell):
+    out = shell.run_command(
+        "fs.configure -locationPrefix /confd/ -collection special "
+        "-fsync -apply")
+    assert "applied" in out
+    cluster.wait_for(
+        lambda: cluster.filer.filer_conf.match("/confd/a") is not None,
+        what="filer reloads filer.conf")
+    rule = cluster.filer.filer_conf.match("/confd/a")
+    assert rule.collection == "special" and rule.fsync
+    # view shows it; delete removes it
+    assert "/confd/" in shell.run_command("fs.configure")
+    shell.run_command("fs.configure -locationPrefix /confd/ -delete -apply")
+    cluster.wait_for(
+        lambda: cluster.filer.filer_conf.match("/confd/a") is None,
+        what="rule removed")
