@@ -90,3 +90,4 @@ from seaweedfs_tpu.command import servers  # noqa: E402,F401
 from seaweedfs_tpu.command import tools  # noqa: E402,F401
 from seaweedfs_tpu.command import benchmark  # noqa: E402,F401
 from seaweedfs_tpu.command import async_services  # noqa: E402,F401
+from seaweedfs_tpu.command import filer_tools  # noqa: E402,F401

@@ -128,6 +128,40 @@ def run_fix(args) -> int:
     return 0
 
 
+@command("compact", "offline-compact a volume's deleted space")
+def run_compact(args) -> int:
+    """Reference weed/command/compact.go: force a compaction of an
+    on-disk volume. Without -commit the result is left as .cpd/.cpx
+    shadow files for INSPECTION ONLY — the next load of the volume
+    treats lingering shadows as an aborted vacuum and deletes them
+    (storage/vacuum.py recover_compaction). Use -commit to actually
+    swap them into place."""
+    p = argparse.ArgumentParser(prog="compact")
+    p.add_argument("-dir", default=".")
+    p.add_argument("-volumeId", dest="volume_id", type=int, required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-commit", action="store_true",
+                   help="rename the shadows over the .dat/.idx")
+    opts = p.parse_args(args)
+    from seaweedfs_tpu.storage.vacuum import commit_compact, compact
+    from seaweedfs_tpu.storage.volume import Volume
+    v = Volume(opts.dir, opts.collection, opts.volume_id,
+               create_if_missing=False, async_write=False)
+    try:
+        state = compact(v)
+        live = len(state.new_offsets)
+        if opts.commit:
+            commit_compact(v, state)
+            print(f"compacted volume {opts.volume_id}: {live} live "
+                  f"needles, committed")
+        else:
+            print(f"compacted volume {opts.volume_id}: {live} live "
+                  f"needles -> {state.cpd_path} / {state.cpx_path}")
+    finally:
+        v.close()
+    return 0
+
+
 @command("export", "export a volume's needles to a tar archive")
 def run_export(args) -> int:
     """Reference weed/command/export.go: dump live needles (name or fid

@@ -1,0 +1,144 @@
+"""filer.cat / filer.copy / filer.meta.tail / compact CLI tools."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from seaweedfs_tpu.command.filer_tools import (run_filer_cat,
+                                               run_filer_copy)
+from seaweedfs_tpu.command.tools import run_compact
+from tests.cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("ftools"), n_volume_servers=1,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+def test_filer_copy_and_cat_roundtrip(cluster, tmp_path, capsys):
+    src = tmp_path / "tree"
+    (src / "sub").mkdir(parents=True)
+    big = os.urandom(3 << 20)           # forces 3 chunks at -maxMB 1
+    (src / "big.bin").write_bytes(big)
+    (src / "sub" / "note.txt").write_bytes(b"hello note")
+    (src / "skip.log").write_bytes(b"no")
+
+    rc = run_filer_copy(["-maxMB", "1", "-include", "*.bin",
+                         str(src), f"http://{cluster.filer.url}/up/"])
+    assert rc == 0
+    # only *.bin matched the walk
+    from seaweedfs_tpu.filer.filerstore import NotFound
+    assert cluster.filer.filer.find_entry("/up/tree/big.bin") is not None
+    with pytest.raises(NotFound):
+        cluster.filer.filer.find_entry("/up/tree/skip.log")
+
+    entry = cluster.filer.filer.find_entry("/up/tree/big.bin")
+    assert len(entry.chunks) == 3       # client-side chunking happened
+    assert entry.attributes.file_size == len(big)
+
+    out = tmp_path / "back.bin"
+    rc = run_filer_cat(["-o", str(out),
+                        f"http://{cluster.filer.url}/up/tree/big.bin"])
+    assert rc == 0
+    assert out.read_bytes() == big
+
+
+def test_filer_copy_single_file_and_cat_stdout(cluster, tmp_path, capsysbinary):
+    f = tmp_path / "one.txt"
+    f.write_bytes(b"single file payload")
+    rc = run_filer_copy([str(f), f"http://{cluster.filer.url}/single/"])
+    assert rc == 0
+    rc = run_filer_cat([f"http://{cluster.filer.url}/single/one.txt"])
+    assert rc == 0
+    assert b"single file payload" in capsysbinary.readouterr().out
+
+
+def test_filer_copy_rejects_non_dir_dest(cluster, tmp_path):
+    f = tmp_path / "x.txt"
+    f.write_bytes(b"x")
+    rc = run_filer_copy([str(f), f"http://{cluster.filer.url}/nodir"])
+    assert rc == 1
+
+
+def test_filer_meta_tail_prints_events(cluster, tmp_path):
+    # write first, then tail with -timeAgo so the subscription replays
+    # the recent log regardless of subprocess startup latency
+    from seaweedfs_tpu.filer import http_client
+    http_client.put(cluster.filer.url, "/tailed/seen.txt", b"abc")
+    http_client.put(cluster.filer.url, "/tailed/ignored.bin", b"def")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "filer.meta.tail",
+         "-filer", cluster.filer.url, "-pathPrefix", "/tailed/",
+         "-pattern", "*.txt", "-timeAgo", "60"],
+        stdout=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        import threading
+        lines = []
+        got = threading.Event()
+
+        def reader():
+            line = proc.stdout.readline()
+            if line:
+                lines.append(line)
+                got.set()
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert got.wait(30), "no event line within 30s"
+        doc = json.loads(lines[0])
+        assert doc["op"] == "create" and doc["new"] == "seen.txt"
+        assert doc["dir"] == "/tailed"
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_compact_tool_offline(tmp_path, capsys):
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+    v = Volume(str(tmp_path), "", 9, async_write=False)
+    keep = Needle(id=1, cookie=7, data=b"live data")
+    drop = Needle(id=2, cookie=8, data=b"dead data")
+    v.write_needle(keep)
+    v.write_needle(drop)
+    v.delete_needle(Needle(id=2, cookie=8))
+    size_before = os.path.getsize(v.dat_path)
+    v.close()
+
+    rc = run_compact(["-dir", str(tmp_path), "-volumeId", "9", "-commit"])
+    assert rc == 0
+    assert "1 live" in capsys.readouterr().out
+    assert os.path.getsize(os.path.join(tmp_path, "9.dat")) < size_before
+
+    v2 = Volume(str(tmp_path), "", 9, create_if_missing=False)
+    try:
+        got = v2.read_needle(Needle(id=1, cookie=7))
+        assert bytes(got.data) == b"live data"
+        import pytest as _pytest
+        from seaweedfs_tpu.storage.needle import NeedleError
+        with _pytest.raises(NeedleError):
+            v2.read_needle(Needle(id=2, cookie=8))
+    finally:
+        v2.close()
+
+
+def test_filer_copy_empty_file(cluster, tmp_path, capsysbinary):
+    """Zero-byte files must copy as chunkless entries (regression: a
+    zero-byte chunk upload was rejected by the volume layer)."""
+    f = tmp_path / "empty.txt"
+    f.write_bytes(b"")
+    rc = run_filer_copy([str(f), f"http://{cluster.filer.url}/e/"])
+    assert rc == 0
+    e = cluster.filer.filer.find_entry("/e/empty.txt")
+    assert not e.chunks and e.attributes.file_size == 0
+    capsysbinary.readouterr()            # drop the copy progress line
+    rc = run_filer_cat([f"http://{cluster.filer.url}/e/empty.txt"])
+    assert rc == 0
+    assert capsysbinary.readouterr().out == b""
