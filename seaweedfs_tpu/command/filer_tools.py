@@ -32,18 +32,6 @@ def _parse_filer_url(arg: str):
     return host, "/" + urllib.parse.unquote(path)
 
 
-def _lookup_fn(stub):
-    """fileId -> [volume server urls] via the filer's LookupVolume
-    (filer_cat.go GetLookupFileIdFunction)."""
-    def lookup(file_id: str):
-        vid = file_id.split(",")[0]
-        resp = stub.LookupVolume(
-            filer_pb2.LookupVolumeRequest(volume_ids=[vid]))
-        locs = resp.locations_map.get(vid)
-        return [l.url for l in locs.locations] if locs else []
-    return lookup
-
-
 @command("filer.cat", "copy one filer file to stdout or a local file")
 def run_filer_cat(args) -> int:
     setup_client_tls()
@@ -64,8 +52,8 @@ def run_filer_cat(args) -> int:
     if entry.is_directory:
         print(f"{path} is a directory", file=sys.stderr)
         return 1
-    from seaweedfs_tpu.filer.stream import stream_content
-    lookup = _lookup_fn(stub)
+    from seaweedfs_tpu.filer.stream import filer_lookup_fn, stream_content
+    lookup = filer_lookup_fn(stub)
     out = open(opts.o, "wb") if opts.o else sys.stdout.buffer
     try:
         # stream_content expands manifest chunks and fetches every
@@ -152,28 +140,43 @@ def _upload_one(stub, local: str, rdir: str, chunk_size: int,
     ttl_sec = TTL.parse(opts.ttl).minutes * 60 if opts.ttl else 0
     st = os.stat(local)
     chunks = []
-    with open(local, "rb") as f:
-        offset = 0
-        while True:
-            data = f.read(chunk_size)
-            if not data:
-                # empty files get an entry with no chunks — the volume
-                # layer refuses zero-byte needles (they'd read as
-                # delete markers)
-                break
-            assign = stub.AssignVolume(filer_pb2.AssignVolumeRequest(
-                count=1, collection=opts.collection,
-                replication=opts.replication, ttl_sec=ttl_sec,
-                path=posixpath.join(rdir, os.path.basename(local))))
-            if assign.error:
-                raise RuntimeError(f"assign: {assign.error}")
-            operations.upload_data(f"{assign.url}/{assign.file_id}", data,
-                                   filename=os.path.basename(local),
-                                   ttl=opts.ttl)
-            chunks.append(filer_pb2.FileChunk(
-                file_id=assign.file_id, offset=offset, size=len(data),
-                mtime=time.time_ns()))
-            offset += len(data)
+    uploaded = []                        # (volume url, fid) for rollback
+    try:
+        with open(local, "rb") as f:
+            offset = 0
+            while True:
+                data = f.read(chunk_size)
+                if not data:
+                    # empty files get an entry with no chunks — the
+                    # volume layer refuses zero-byte needles (they'd
+                    # read as delete markers)
+                    break
+                assign = stub.AssignVolume(filer_pb2.AssignVolumeRequest(
+                    count=1, collection=opts.collection,
+                    replication=opts.replication, ttl_sec=ttl_sec,
+                    path=posixpath.join(rdir, os.path.basename(local))))
+                if assign.error:
+                    raise RuntimeError(f"assign: {assign.error}")
+                operations.upload_data(
+                    f"{assign.url}/{assign.file_id}", data,
+                    filename=os.path.basename(local), ttl=opts.ttl)
+                uploaded.append((assign.url, assign.file_id))
+                chunks.append(filer_pb2.FileChunk(
+                    file_id=assign.file_id, offset=offset,
+                    size=len(data), mtime=time.time_ns()))
+                offset += len(data)
+    except Exception:
+        # delete the chunks already uploaded: with no entry referencing
+        # them they would sit as orphans until a volume.fsck purge
+        # (reference filer_copy.go deletes collected fids on failure)
+        import urllib.request
+        for url, fid in uploaded:
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://{url}/{fid}", method="DELETE"), timeout=10)
+            except OSError:
+                pass
+        raise
     now = int(time.time())
     resp = stub.CreateEntry(filer_pb2.CreateEntryRequest(
         directory=rdir,

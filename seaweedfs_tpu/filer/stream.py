@@ -21,6 +21,19 @@ from seaweedfs_tpu.util.cipher import decrypt
 LookupFn = Callable[[str], List[str]]  # fileId -> [volume server urls]
 
 
+def filer_lookup_fn(stub) -> LookupFn:
+    """fileId -> [volume server urls] resolved through a filer stub's
+    LookupVolume (the way filer clients locate chunk bytes, reference
+    filer_cat.go GetLookupFileIdFunction)."""
+    def lookup(file_id: str):
+        vid = file_id.split(",")[0]
+        resp = stub.LookupVolume(
+            filer_pb2.LookupVolumeRequest(volume_ids=[vid]))
+        locs = resp.locations_map.get(vid)
+        return [l.url for l in locs.locations] if locs else []
+    return lookup
+
+
 def fetch_chunk_bytes(lookup: LookupFn, file_id: str,
                       cipher_key: bytes = b"",
                       is_compressed: bool = False,

@@ -281,6 +281,8 @@ class VolumeServer:
             volume_id=v.id,
             idx_file_size=os.path.getsize(base + ".idx"),
             dat_file_size=os.path.getsize(base + ".dat"),
+            idx_file_timestamp_seconds=int(os.path.getmtime(base + ".idx")),
+            dat_file_timestamp_seconds=int(os.path.getmtime(base + ".dat")),
             file_count=v.file_count,
             compaction_revision=v.super_block.compaction_revision,
             collection=v.collection)

@@ -142,3 +142,42 @@ def test_filer_copy_empty_file(cluster, tmp_path, capsysbinary):
     rc = run_filer_cat([f"http://{cluster.filer.url}/e/empty.txt"])
     assert rc == 0
     assert capsysbinary.readouterr().out == b""
+
+
+def test_filer_copy_rolls_back_chunks_on_failure(cluster, tmp_path,
+                                                 monkeypatch):
+    """A mid-file failure must delete the chunks already uploaded, so
+    nothing is left for volume.fsck to find (regression: they leaked
+    as orphans)."""
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.operation import operations
+    real = operations.upload_data
+    seen = []
+
+    def flaky(url_fid, *a, **kw):
+        if len(seen) == 1:
+            raise RuntimeError("induced chunk-2 failure")
+        seen.append(url_fid)
+        return real(url_fid, *a, **kw)
+    monkeypatch.setattr(operations, "upload_data", flaky)
+
+    f = tmp_path / "twochunks.bin"
+    f.write_bytes(os.urandom(2 << 20))
+    rc = run_filer_copy(["-maxMB", "1", str(f),
+                         f"http://{cluster.filer.url}/rb/"])
+    assert rc == 1                       # the copy failed...
+    import pytest as _p
+    from seaweedfs_tpu.filer.filerstore import NotFound
+    with _p.raises(NotFound):            # ...left no entry...
+        cluster.filer.filer.find_entry("/rb/twochunks.bin")
+    # ...and the already-uploaded first chunk was deleted again
+    assert len(seen) == 1
+    with _p.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"http://{seen[0]}", timeout=10)
+    assert ei.value.code == 404
+    # (re-run a successful copy to prove the path still works)
+    monkeypatch.setattr(operations, "upload_data", real)
+    assert run_filer_copy(["-maxMB", "1", str(f),
+                           f"http://{cluster.filer.url}/rb/"]) == 0

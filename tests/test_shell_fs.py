@@ -126,3 +126,63 @@ def test_fs_meta_save_load_roundtrip(shell, cluster, tmp_path):
     assert "spec.json" in shell.run_command("fs.ls /docs/api")
     # chunks were preserved, so the content still reads back
     assert shell.run_command("fs.cat /docs/api/spec.json") == '{"v": 1}'
+
+
+# -- volume.fsck ---------------------------------------------------------------
+
+
+def test_volume_fsck_finds_and_purges_orphans(cluster, shell):
+    from seaweedfs_tpu.filer import http_client
+    from seaweedfs_tpu.operation.file_id import parse_fid
+
+    # referenced data: written through the filer
+    http_client.put(cluster.filer.url, "/fsck/good.bin", b"G" * 4096)
+    # orphan: assigned+uploaded directly, never referenced by the filer
+    orphan_fid = cluster.upload(b"O" * 2048)
+
+    out = shell.run_command("volume.fsck -v")
+    assert "orphan" in out
+    vid = parse_fid(orphan_fid).volume_id
+    assert f"volume {vid}: 1 orphan blobs (" in out
+
+    # a freshly-written volume is protected by the cutoff window...
+    out = shell.run_command("volume.fsck -reallyDeleteFromVolume")
+    assert "skip purging" in out
+    # ...and purges once the operator overrides the cutoff
+    out = shell.run_command(
+        "volume.fsck -reallyDeleteFromVolume -cutoffTimeAgo 0")
+    assert f"volume {vid}: purged 1/1 blobs" in out
+
+    out = shell.run_command("volume.fsck")
+    assert "total" in out and " 0 orphans" in out
+    # the referenced file is untouched
+    status, body, _ = http_client.get(cluster.filer.url, "/fsck/good.bin")
+    assert status == 200 and body == b"G" * 4096
+
+
+def test_volume_fsck_counts_manifest_chunks(cluster, shell):
+    """Chunks hidden behind a manifest chunk must count as referenced,
+    not orphans — fsck has to expand the manifest blob."""
+    from seaweedfs_tpu.pb import filer_pb2
+    # two data chunks stored directly on volume servers
+    inner = []
+    pos = 0
+    for piece in (b"A" * 1024, b"B" * 2048):
+        fid = cluster.upload(piece)
+        inner.append(filer_pb2.FileChunk(file_id=fid, offset=pos,
+                                         size=len(piece)))
+        pos += len(piece)
+    # the manifest blob referencing them, itself stored as a needle
+    manifest = filer_pb2.FileChunkManifest(chunks=inner)
+    mfid = cluster.upload(manifest.SerializeToString())
+    entry = filer_pb2.Entry(
+        name="manifested.bin", is_directory=False,
+        chunks=[filer_pb2.FileChunk(file_id=mfid, offset=0, size=pos,
+                                    is_chunk_manifest=True)],
+        attributes=filer_pb2.FuseAttributes(file_size=pos))
+    resp = shell.env.filer.CreateEntry(filer_pb2.CreateEntryRequest(
+        directory="/mfsck", entry=entry))
+    assert not resp.error
+    out = shell.run_command("volume.fsck")
+    # neither the manifest blob nor the inner chunks are orphans
+    assert " 0 orphans" in out
