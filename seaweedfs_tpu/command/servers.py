@@ -216,7 +216,7 @@ def _build_filer(opts):
     # (reference scaffold.go [redis]/[etcd]/[mysql]/[postgres])
     store_options = config_mod.load_configuration("filer") \
         .get(opts.store) or {}
-    return FilerServer(
+    fs = FilerServer(
         opts.master, ip=opts.ip, port=opts.port, store=opts.store,
         store_options=store_options,
         meta_dir=opts.dir, collection=opts.collection,
@@ -224,6 +224,15 @@ def _build_filer(opts):
         chunk_size=opts.max_mb << 20, cipher=opts.cipher,
         cache_dir=os.path.join(opts.dir, "cache"),
         peers=peers)
+    # notification.toml: publish every metadata mutation to the first
+    # enabled [notification.X] queue (reference filer.go
+    # LoadConfiguration("notification"))
+    from seaweedfs_tpu import notification
+    queue = notification.from_config(
+        config_mod.load_configuration("notification"))
+    if queue is not None:
+        fs.filer.notification_queue = queue
+    return fs
 
 
 @command("filer", "start a filer (namespace server)")

@@ -278,11 +278,27 @@ directory = ""
 """,
     "notification": """\
 # notification.toml (reference command/scaffold.go [notification.*])
+# At most one enabled section is used; everything ships disabled so the
+# stock scaffold never breaks filer startup.
 [notification.log]
-enabled = true
+enabled = false
+path = "/tmp/seaweedfs_events.log"
 
 [notification.memory]
 enabled = false
+
+# AWS SQS over plain HTTP + SigV4 (no SDK needed). Give either the
+# queue name (resolved via GetQueueUrl) or the queue_url directly;
+# endpoint overrides the public sqs.<region>.amazonaws.com for
+# SQS-compatible emulators.
+[notification.aws_sqs]
+enabled = false
+aws_access_key_id = ""
+aws_secret_access_key = ""
+region = "us-east-1"
+sqs_queue_name = "my_sqs_queue"
+# queue_url = "http://localhost:9324/000000000000/my_sqs_queue"
+# endpoint = "localhost:9324"
 """,
 }
 

@@ -82,11 +82,17 @@ def _gated(name: str, package: str) -> Callable[..., MessageQueue]:
     return factory
 
 
+def _aws_sqs_factory(**kw) -> MessageQueue:
+    # lazy import: aws_sqs imports MessageQueue from this module
+    from seaweedfs_tpu.notification.aws_sqs import AwsSqsQueue
+    return AwsSqsQueue(**kw)
+
+
 _REGISTRY: Dict[str, Callable[..., MessageQueue]] = {
     "memory": MemoryQueue,
     "log": LogQueue,
     "kafka": _gated("kafka", "kafka-python"),
-    "aws_sqs": _gated("aws_sqs", "boto3"),
+    "aws_sqs": _aws_sqs_factory,   # SigV4 over HTTP, no SDK needed
     "google_pub_sub": _gated("google_pub_sub", "google-cloud-pubsub"),
     "gocdk_pub_sub": _gated("gocdk_pub_sub", "a Go CDK bridge"),
 }
@@ -103,3 +109,17 @@ def new_queue(name: str, **kwargs) -> MessageQueue:
             f"notification backend {name!r} not available in this "
             f"image; registered: {sorted(_REGISTRY)}")
     return factory(**kwargs)
+
+
+def from_config(conf) -> Optional[MessageQueue]:
+    """Build the queue from a notification.toml Configuration: the
+    first enabled [notification.X] section wins, its remaining keys
+    become factory kwargs (reference notification.LoadConfiguration,
+    weed/notification/configuration.go)."""
+    sections = (conf.get("notification") or {}) if conf else {}
+    for name, props in sections.items():
+        if not isinstance(props, dict) or not props.get("enabled"):
+            continue
+        kwargs = {k: v for k, v in props.items() if k != "enabled"}
+        return new_queue(name, **kwargs)
+    return None

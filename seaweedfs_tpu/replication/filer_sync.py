@@ -52,7 +52,13 @@ class _OneWay:
                     if ev.is_from_other_cluster:
                         continue  # our own replay echoing back
                     try:
-                        self.replicator.replicate(rec.directory, ev)
+                        # metadata-log records carry the parent dir;
+                        # the replicator takes full-path keys
+                        import posixpath
+                        name = ev.old_entry.name or ev.new_entry.name
+                        self.replicator.replicate(
+                            posixpath.join(rec.directory, name)
+                            if name else rec.directory, ev)
                     except Exception:
                         # one unreplayable event (e.g. source chunk
                         # already deleted) must not kill the tail

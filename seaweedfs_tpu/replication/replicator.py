@@ -21,9 +21,19 @@ class Replicator:
     def _in_scope(self, path: str) -> bool:
         return path.startswith(self.path_filter)
 
-    def replicate(self, directory: str,
+    def replicate(self, key: str,
                   event: filer_pb2.EventNotification) -> None:
+        """`key` is the event's full entry path (the notification-queue
+        key; for renames, the OLD path — reference replicator.go). A
+        key that doesn't end in the entry's own name is tolerated as a
+        plain parent directory."""
+        import posixpath
         old, new = event.old_entry, event.new_entry
+        k = key.rstrip("/") or "/"
+        if posixpath.basename(k) == (old.name or new.name):
+            directory = posixpath.dirname(k) or "/"
+        else:
+            directory = key
         old_path = join_path(directory, old.name) if old.name else ""
         new_dir = event.new_parent_path or directory
         new_path = join_path(new_dir, new.name) if new.name else ""

@@ -304,3 +304,38 @@ def fs_configure(env, argv, out):
         out.write("applied\n")
     elif args.locationPrefix:
         out.write("use -apply to save\n")
+
+
+@command("fs.meta.notify",
+         "resend a subtree's metadata to the notification queue")
+def fs_meta_notify(env, argv, out):
+    """Walk the directory and publish a create event per entry to the
+    queue configured in notification.toml — the way an operator
+    re-seeds replication for data that predates the queue (reference
+    weed/shell/command_fs_meta_notify.go)."""
+    from seaweedfs_tpu import notification
+    from seaweedfs_tpu.pb import filer_pb2
+    from seaweedfs_tpu.util import config as config_mod
+    _, path = _flags_and_path(env, argv)
+    queue = notification.from_config(
+        config_mod.load_configuration("notification"))
+    if queue is None:
+        raise ValueError(
+            "no enabled [notification.*] section in notification.toml")
+    dirs = files = 0
+
+    def publish(directory: str):
+        nonlocal dirs, files
+        for entry in env.list_filer_entries(directory):
+            queue.send_message(
+                posixpath.join(directory, entry.name),
+                filer_pb2.EventNotification(new_entry=entry,
+                                            new_parent_path=directory))
+            if entry.is_directory:
+                dirs += 1
+                publish(posixpath.join(directory, entry.name))
+            else:
+                files += 1
+
+    publish(env.resolve_path(path))
+    print(f"notified {dirs} directories, {files} files", file=out)

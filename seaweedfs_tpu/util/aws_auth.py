@@ -1,0 +1,72 @@
+"""AWS Signature Version 4 on the standard library — shared by every
+client that speaks an AWS wire protocol without an SDK: the S3 client
+(util/s3_client.py), the SQS notification queue (notification/aws_sqs),
+and the cloud replication sinks.
+
+Reference counterpart: the aws-sdk-go signer the Go code relies on
+(weed/replication/sink/s3sink, weed/notification/aws_sqs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+from typing import Dict, List, Optional, Tuple
+
+
+def uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-_.~" if encode_slash else "-_.~/"
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_query(query: List[Tuple[str, str]]) -> str:
+    return "&".join(f"{uri_encode(k)}={uri_encode(v)}"
+                    for k, v in sorted(query))
+
+
+def sigv4_headers(method: str, host: str, path: str,
+                  query: List[Tuple[str, str]],
+                  headers: Dict[str, str], payload: bytes,
+                  access_key: str, secret_key: str,
+                  region: str, service: str,
+                  payload_hash: Optional[str] = None) -> Dict[str, str]:
+    """Lower-cased headers dict including host/x-amz-date/
+    x-amz-content-sha256/authorization, ready to send."""
+    t = time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+    date = time.strftime("%Y%m%d", t)
+    if payload_hash is None:
+        payload_hash = hashlib.sha256(payload).hexdigest()
+    h = {k.lower(): str(v) for k, v in headers.items()}
+    h["host"] = host
+    h["x-amz-date"] = amz_date
+    h["x-amz-content-sha256"] = payload_hash
+    signed = sorted(h)
+    canonical = "\n".join([
+        method,
+        uri_encode(path, encode_slash=False),
+        canonical_query(query),
+        "".join(f"{k}:{' '.join(h[k].split())}\n" for k in signed),
+        ";".join(signed),
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def hm(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = hm(("AWS4" + secret_key).encode(), date)
+    k = hm(k, region)
+    k = hm(k, service)
+    k = hm(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+    h["authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={signature}")
+    return h

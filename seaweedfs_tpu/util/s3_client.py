@@ -12,13 +12,13 @@ package's own s3api gateway, which the tests use as the server side.
 from __future__ import annotations
 
 import hashlib
-import hmac
-import time
 import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from seaweedfs_tpu.util.aws_auth import canonical_query, sigv4_headers
 
 
 class S3Error(Exception):
@@ -26,11 +26,6 @@ class S3Error(Exception):
         super().__init__(f"S3 request failed: HTTP {status} {body[:200]}")
         self.status = status
         self.body = body
-
-
-def _uri_encode(s: str, encode_slash: bool = True) -> str:
-    safe = "-_.~" if encode_slash else "-_.~/"
-    return urllib.parse.quote(s, safe=safe)
 
 
 class S3Client:
@@ -51,45 +46,9 @@ class S3Client:
     def _sign(self, method: str, path: str, query: List[Tuple[str, str]],
               headers: Dict[str, str], payload: bytes,
               payload_hash: Optional[str] = None) -> Dict[str, str]:
-        t = time.gmtime()
-        amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
-        date = time.strftime("%Y%m%d", t)
-        if payload_hash is None:
-            payload_hash = hashlib.sha256(payload).hexdigest()
-        h = {k.lower(): str(v) for k, v in headers.items()}
-        h["host"] = self.endpoint
-        h["x-amz-date"] = amz_date
-        h["x-amz-content-sha256"] = payload_hash
-        signed = sorted(h)
-        canonical_query = "&".join(
-            f"{_uri_encode(k)}={_uri_encode(v)}"
-            for k, v in sorted(query))
-        canonical = "\n".join([
-            method,
-            _uri_encode(path, encode_slash=False),
-            canonical_query,
-            "".join(f"{k}:{' '.join(h[k].split())}\n" for k in signed),
-            ";".join(signed),
-            payload_hash,
-        ])
-        scope = f"{date}/{self.region}/s3/aws4_request"
-        string_to_sign = "\n".join([
-            "AWS4-HMAC-SHA256", amz_date, scope,
-            hashlib.sha256(canonical.encode()).hexdigest()])
-
-        def hm(key: bytes, msg: str) -> bytes:
-            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-        k = hm(("AWS4" + self.secret_key).encode(), date)
-        k = hm(k, self.region)
-        k = hm(k, "s3")
-        k = hm(k, "aws4_request")
-        signature = hmac.new(k, string_to_sign.encode(),
-                             hashlib.sha256).hexdigest()
-        h["authorization"] = (
-            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-            f"SignedHeaders={';'.join(signed)}, Signature={signature}")
-        return h
+        return sigv4_headers(method, self.endpoint, path, query, headers,
+                             payload, self.access_key, self.secret_key,
+                             self.region, "s3", payload_hash=payload_hash)
 
     def _request(self, method: str, path: str,
                  query: Optional[List[Tuple[str, str]]] = None,
@@ -101,8 +60,7 @@ class S3Client:
         # the SAME encoder (and order) as the canonical query string:
         # urlencode's quote_plus turns spaces into '+', which strict
         # SigV4 servers reject as SignatureDoesNotMatch
-        qs = "&".join(f"{_uri_encode(k)}={_uri_encode(v)}"
-                      for k, v in sorted(query))
+        qs = canonical_query(query)
         url = f"http://{self.endpoint}{urllib.parse.quote(path)}" + \
             (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=payload or None,

@@ -1,0 +1,201 @@
+"""AWS SQS notification backend against a local fake SQS endpoint,
+plus notification.from_config and the fs.meta.notify shell command."""
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from seaweedfs_tpu import notification
+from seaweedfs_tpu.notification.aws_sqs import AwsSqsQueue, SqsError
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.util.config import Configuration
+
+
+class _FakeSqs:
+    """Minimal SQS query-protocol server: GetQueueUrl + SendMessage.
+    Records parsed request params for assertions."""
+
+    def __init__(self):
+        self.requests = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                params = dict(urllib.parse.parse_qsl(body.decode()))
+                outer.requests.append(
+                    {"params": params,
+                     "auth": self.headers.get("Authorization", ""),
+                     "path": self.path})
+                action = params.get("Action")
+                if action == "GetQueueUrl":
+                    if params.get("QueueName") != "events":
+                        self.send_response(400)
+                        self.end_headers()
+                        self.wfile.write(b"<Error><Code>"
+                                         b"AWS.SimpleQueueService."
+                                         b"NonExistentQueue</Code></Error>")
+                        return
+                    url = (f"http://{self.headers['Host']}"
+                           f"/000000000000/events")
+                    out = (f"<GetQueueUrlResponse><GetQueueUrlResult>"
+                           f"<QueueUrl>{url}</QueueUrl>"
+                           f"</GetQueueUrlResult></GetQueueUrlResponse>")
+                elif action == "SendMessage":
+                    out = ("<SendMessageResponse><SendMessageResult>"
+                           "<MessageId>mid-1</MessageId>"
+                           "</SendMessageResult></SendMessageResponse>")
+                else:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                blob = out.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+
+    @property
+    def endpoint(self):
+        return f"127.0.0.1:{self.server.server_address[1]}"
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def sqs():
+    s = _FakeSqs()
+    yield s
+    s.stop()
+
+
+def _event(name=b"f.txt"):
+    return filer_pb2.EventNotification(
+        new_entry=filer_pb2.Entry(name="f.txt"),
+        new_parent_path="/dir")
+
+
+def test_sqs_resolves_queue_and_sends(sqs):
+    q = AwsSqsQueue(sqs_queue_name="events", aws_access_key_id="AK",
+                    aws_secret_access_key="SK", region="eu-west-1",
+                    endpoint=sqs.endpoint)
+    assert q.queue_url.endswith("/000000000000/events")
+    q.send_message("/dir/f.txt", _event())
+
+    get_url, send = sqs.requests
+    assert get_url["params"]["Action"] == "GetQueueUrl"
+    p = send["params"]
+    assert send["path"] == "/000000000000/events"
+    assert p["Action"] == "SendMessage"
+    assert p["MessageAttribute.1.Name"] == "key"
+    assert p["MessageAttribute.1.Value.StringValue"] == "/dir/f.txt"
+    # body is the reference's protobuf text format of the event
+    from google.protobuf import text_format
+    ev = filer_pb2.EventNotification()
+    text_format.Parse(p["MessageBody"], ev)
+    assert ev.new_entry.name == "f.txt"
+    assert ev.new_parent_path == "/dir"
+    # SigV4 with service=sqs, both calls signed
+    for r in sqs.requests:
+        assert "AWS4-HMAC-SHA256" in r["auth"]
+        assert "/eu-west-1/sqs/aws4_request" in r["auth"]
+        assert "Credential=AK/" in r["auth"]
+
+
+def test_sqs_unknown_queue_fails_loudly(sqs):
+    with pytest.raises(SqsError, match="HTTP 400"):
+        AwsSqsQueue(sqs_queue_name="nope", endpoint=sqs.endpoint)
+
+
+def test_sqs_direct_queue_url_skips_discovery(sqs):
+    q = AwsSqsQueue(queue_url=f"http://{sqs.endpoint}/1/direct",
+                    aws_access_key_id="A", aws_secret_access_key="S")
+    q.send_message("k", _event())
+    assert len(sqs.requests) == 1
+    assert sqs.requests[0]["path"] == "/1/direct"
+
+
+def test_from_config_picks_first_enabled(tmp_path, sqs):
+    conf = Configuration({"notification": {
+        "memory": {"enabled": False},
+        "aws_sqs": {"enabled": True, "endpoint": sqs.endpoint,
+                    "sqs_queue_name": "events",
+                    "aws_access_key_id": "AK",
+                    "aws_secret_access_key": "SK"},
+    }})
+    q = notification.from_config(conf)
+    assert isinstance(q, AwsSqsQueue)
+    assert notification.from_config(None) is None
+    assert notification.from_config(
+        Configuration({"notification": {
+            "memory": {"enabled": False}}})) is None
+
+
+def test_fs_meta_notify_publishes_subtree(tmp_path, monkeypatch):
+    from seaweedfs_tpu.filer import http_client
+    from seaweedfs_tpu.shell import Shell
+    from tests.cluster_util import Cluster
+    c = Cluster(tmp_path / "cluster", n_volume_servers=1,
+                with_filer=True)
+    try:
+        http_client.put(c.filer.url, "/seed/a.txt", b"a")
+        http_client.put(c.filer.url, "/seed/sub/b.txt", b"b")
+        # notification.toml in cwd selects the log queue
+        log_path = tmp_path / "events.log"
+        (tmp_path / "notification.toml").write_text(
+            f'[notification.log]\nenabled = true\n'
+            f'path = "{log_path}"\n')
+        monkeypatch.chdir(tmp_path)
+        sh = Shell(c.master.url, filer_url=c.filer.url)
+        out = sh.run_command("fs.meta.notify /seed")
+        assert "notified 1 directories, 2 files" in out
+        from seaweedfs_tpu.notification import LogQueue
+        events = LogQueue(str(log_path)).read_all()
+        keys = {k for k, _ in events}
+        assert keys == {"/seed/a.txt", "/seed/sub", "/seed/sub/b.txt"}
+    finally:
+        c.stop()
+
+
+def test_sqs_endpoint_scheme_rules():
+    """Bare AWS default must be https; explicit schemes are preserved;
+    bare host:port (emulator) gets http (regression: https endpoints
+    were silently downgraded to cleartext)."""
+    q = AwsSqsQueue(queue_url="http://h/1/q", region="eu-central-1")
+    assert q.endpoint == "https://sqs.eu-central-1.amazonaws.com"
+    q2 = AwsSqsQueue(queue_url="http://h/1/q",
+                     endpoint="https://secure.example:8443")
+    assert q2.endpoint == "https://secure.example:8443"
+    q3 = AwsSqsQueue(queue_url="http://h/1/q", endpoint="127.0.0.1:9324")
+    assert q3.endpoint == "http://127.0.0.1:9324"
+
+
+def test_filer_notification_key_is_entry_fullpath(tmp_path):
+    """Live filer events and fs.meta.notify re-seeds must use the same
+    key (the entry's full path) so consumers can dedup."""
+    from seaweedfs_tpu.filer import http_client
+    from seaweedfs_tpu.notification import MemoryQueue
+    from tests.cluster_util import Cluster
+    c = Cluster(tmp_path, n_volume_servers=1, with_filer=True)
+    try:
+        q = MemoryQueue()
+        c.filer.filer.notification_queue = q
+        http_client.put(c.filer.url, "/kx/file.txt", b"data")
+        keys = {k for k, _ in q.messages}
+        assert "/kx/file.txt" in keys
+        assert "/kx" in keys          # the auto-created parent dir
+    finally:
+        c.stop()
