@@ -287,6 +287,13 @@ path = "/tmp/seaweedfs_events.log"
 [notification.memory]
 enabled = false
 
+# Kafka over the binary wire protocol (no SDK needed): Metadata +
+# Produce v3 with record batches, sarama-compatible key partitioning.
+[notification.kafka]
+enabled = false
+hosts = ["localhost:9092"]
+topic = "seaweedfs_filer"
+
 # AWS SQS over plain HTTP + SigV4 (no SDK needed). Give either the
 # queue name (resolved via GetQueueUrl) or the queue_url directly;
 # endpoint overrides the public sqs.<region>.amazonaws.com for

@@ -6,11 +6,11 @@ filer_rename.go, filer_delete_entry.go, filer_buckets.go).
 
 from __future__ import annotations
 
-import posixpath
 import time
 from typing import Callable, List, Optional, Tuple
 
 from seaweedfs_tpu.filer import filechunk_manifest, filechunks
+from seaweedfs_tpu.filer import filer_notify as filer_notify_mod
 from seaweedfs_tpu.filer.filer_notify import MetaLog
 from seaweedfs_tpu.filer.filerstore import (
     FilerStore, FilerStoreWrapper, NotFound, join_path, normalize_path,
@@ -124,16 +124,9 @@ class Filer:
             except Exception:
                 pass  # the merged view is best-effort; local log is canonical
         if self.notification_queue is not None:
-            # keyed by the ENTRY's full path (reference filer_notify.go
-            # fullpath), matching fs.meta.notify's re-seeded events so
-            # consumers can partition/dedup on the key; renames are
-            # keyed by the OLD path (directory here is the old parent)
-            name = (ev.old_entry.name if ev.HasField("old_entry")
-                    else ev.new_entry.name if ev.HasField("new_entry")
-                    else "")
-            key = posixpath.join(directory, name) if name else directory
             try:
-                self.notification_queue.send_message(key, ev)
+                self.notification_queue.send_message(
+                    filer_notify_mod.event_key(directory, ev), ev)
             except Exception:
                 # the write already committed; a broken external queue
                 # must not turn it into a client-visible failure

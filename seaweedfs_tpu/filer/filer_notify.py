@@ -17,6 +17,19 @@ from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.util.log_buffer import LogBuffer, LogEntry
 
 
+def event_key(directory: str, ev: filer_pb2.EventNotification) -> str:
+    """The canonical notification key for an event: the ENTRY's full
+    path under its (old) parent directory — renames keyed by the OLD
+    path (reference filer_notify.go fullpath). The ONE definition used
+    by the live filer publish path, filer.sync tailers, and
+    fs.meta.notify so consumers can partition/dedup consistently."""
+    import posixpath
+    name = (ev.old_entry.name if ev.HasField("old_entry")
+            else ev.new_entry.name if ev.HasField("new_entry")
+            else "")
+    return posixpath.join(directory, name) if name else directory
+
+
 def _segment_name(ts_ns: int) -> str:
     t = time.gmtime(ts_ns / 1e9)
     return os.path.join(time.strftime("%Y-%m-%d", t),

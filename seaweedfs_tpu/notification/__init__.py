@@ -88,10 +88,15 @@ def _aws_sqs_factory(**kw) -> MessageQueue:
     return AwsSqsQueue(**kw)
 
 
+def _kafka_factory(**kw) -> MessageQueue:
+    from seaweedfs_tpu.notification.kafka import KafkaQueue
+    return KafkaQueue(**kw)
+
+
 _REGISTRY: Dict[str, Callable[..., MessageQueue]] = {
     "memory": MemoryQueue,
     "log": LogQueue,
-    "kafka": _gated("kafka", "kafka-python"),
+    "kafka": _kafka_factory,       # binary wire protocol, no SDK needed
     "aws_sqs": _aws_sqs_factory,   # SigV4 over HTTP, no SDK needed
     "google_pub_sub": _gated("google_pub_sub", "google-cloud-pubsub"),
     "gocdk_pub_sub": _gated("gocdk_pub_sub", "a Go CDK bridge"),

@@ -77,6 +77,8 @@ class AwsSqsQueue(MessageQueue):
             raise SqsError(
                 f"SQS HTTP {e.code}: "
                 f"{e.read().decode('utf-8', 'replace')[:300]}") from None
+        except OSError as e:   # URLError, timeouts, refused connections
+            raise SqsError(f"SQS {u.netloc} unreachable: {e}") from None
 
     def _get_queue_url(self, name: str) -> str:
         body = self._call(self.endpoint, [

@@ -54,11 +54,10 @@ class _OneWay:
                     try:
                         # metadata-log records carry the parent dir;
                         # the replicator takes full-path keys
-                        import posixpath
-                        name = ev.old_entry.name or ev.new_entry.name
+                        from seaweedfs_tpu.filer.filer_notify import \
+                            event_key
                         self.replicator.replicate(
-                            posixpath.join(rec.directory, name)
-                            if name else rec.directory, ev)
+                            event_key(rec.directory, ev), ev)
                     except Exception:
                         # one unreplayable event (e.g. source chunk
                         # already deleted) must not kill the tail

@@ -23,17 +23,13 @@ class Replicator:
 
     def replicate(self, key: str,
                   event: filer_pb2.EventNotification) -> None:
-        """`key` is the event's full entry path (the notification-queue
-        key; for renames, the OLD path — reference replicator.go). A
-        key that doesn't end in the entry's own name is tolerated as a
-        plain parent directory."""
+        """`key` is the event's full entry path — the notification-queue
+        key produced by filer_notify.event_key (for renames, the OLD
+        path), reference replicator.go. Parent-directory keys are NOT
+        accepted; tailers convert with event_key first."""
         import posixpath
         old, new = event.old_entry, event.new_entry
-        k = key.rstrip("/") or "/"
-        if posixpath.basename(k) == (old.name or new.name):
-            directory = posixpath.dirname(k) or "/"
-        else:
-            directory = key
+        directory = posixpath.dirname(key.rstrip("/") or "/") or "/"
         old_path = join_path(directory, old.name) if old.name else ""
         new_dir = event.new_parent_path or directory
         new_path = join_path(new_dir, new.name) if new.name else ""

@@ -56,8 +56,11 @@ class TestNotification:
         assert isinstance(new_queue("memory"), MemoryQueue)
         assert isinstance(
             new_queue("log", path=str(tmp_path / "l.log")), LogQueue)
-        # kafka is registered but gated on its missing client library
-        with pytest.raises(RuntimeError, match="kafka"):
+        # google_pub_sub is registered but gated on its missing SDK
+        with pytest.raises(RuntimeError, match="google_pub_sub"):
+            new_queue("google_pub_sub")
+        # kafka is real now (wire protocol) but needs a reachable broker
+        with pytest.raises(ValueError, match="hosts"):
             new_queue("kafka")
         with pytest.raises(ValueError):
             new_queue("never-heard-of-it")
@@ -288,8 +291,8 @@ def test_sink_registry_and_gated_backends():
     sink = make_sink("azure", account_name="a", account_key="a2V5",
                      container="c")
     assert sink.container == "c"
-    with _pytest.raises(RuntimeError, match="kafka"):
-        notification.new_queue("kafka")
+    with _pytest.raises(RuntimeError, match="google_pub_sub"):
+        notification.new_queue("google_pub_sub")
 
 
 class TestMessagingChannelsAndCluster:

@@ -1,0 +1,310 @@
+"""Kafka notification backend against an in-process fake broker that
+speaks enough of the wire protocol to validate our requests."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from seaweedfs_tpu.notification.kafka import (KafkaError, KafkaQueue,
+                                              crc32c, encode_record_batch,
+                                              fnv1a_32, partition_for_key,
+                                              read_varint)
+from seaweedfs_tpu.pb import filer_pb2
+
+
+class _FakeBroker:
+    """Single-node fake Kafka: answers Metadata v1 (all partitions led
+    by itself) and Produce v3 (records the raw batch)."""
+
+    def __init__(self, topic="events", partitions=2, produce_error=0,
+                 leaderless=()):
+        self.topic = topic
+        self.partitions = partitions
+        self.produce_error = produce_error
+        self.leaderless = set(leaderless)  # pids reported with no leader
+        self.produced = []   # (topic, partition, raw_batch_bytes)
+        self.requests = []   # (api_key, api_version, client_id)
+        self.server = socket.socket()
+        self.server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.server.bind(("127.0.0.1", 0))
+        self.server.listen(8)
+        self.port = self.server.getsockname()[1]
+        self._stop = False
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    @property
+    def host(self):
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.server.close()
+        except OSError:
+            pass
+
+    # -- protocol plumbing ----------------------------------------------------
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self.server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        try:
+            while True:
+                raw = self._read(conn, 4)
+                (size,) = struct.unpack(">i", raw)
+                msg = self._read(conn, size)
+                api_key, api_version, corr = struct.unpack_from(">hhi",
+                                                                msg, 0)
+                (clen,) = struct.unpack_from(">h", msg, 8)
+                client_id = msg[10:10 + clen].decode()
+                body = msg[10 + clen:]
+                self.requests.append((api_key, api_version, client_id))
+                if api_key == 3:
+                    resp = self._metadata_response()
+                elif api_key == 0:
+                    resp = self._produce_response(body)
+                else:
+                    return
+                out = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(out)) + out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise OSError("closed")
+            buf += chunk
+        return buf
+
+    def _metadata_response(self):
+        def s(x):
+            b = x.encode()
+            return struct.pack(">h", len(b)) + b
+        out = struct.pack(">i", 1)                        # 1 broker
+        out += struct.pack(">i", 7) + s("127.0.0.1") + \
+            struct.pack(">i", self.port) + struct.pack(">h", -1)
+        out += struct.pack(">i", 7)                       # controller_id
+        out += struct.pack(">i", 1)                       # 1 topic
+        out += struct.pack(">h", 0) + s(self.topic) + b"\x00"
+        out += struct.pack(">i", self.partitions)
+        for pid in range(self.partitions):
+            leader = -1 if pid in self.leaderless else 7
+            out += struct.pack(">hii", 0, pid, leader)
+            out += struct.pack(">i", 1) + struct.pack(">i", 7)  # replicas
+            out += struct.pack(">i", 1) + struct.pack(">i", 7)  # isr
+        return out
+
+    def _produce_response(self, body):
+        pos = 0
+        (tid_len,) = struct.unpack_from(">h", body, pos)  # transactional
+        pos += 2 + max(tid_len, 0)
+        pos += 2 + 4                                      # acks, timeout
+        (n_topics,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        (tlen,) = struct.unpack_from(">h", body, pos)
+        pos += 2
+        topic = body[pos:pos + tlen].decode()
+        pos += tlen
+        (n_parts,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        (pid,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        (blen,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        batch = body[pos:pos + blen]
+        self.produced.append((topic, pid, batch))
+        # response: [topic [partition err base_offset]] throttle
+        def s(x):
+            b = x.encode()
+            return struct.pack(">h", len(b)) + b
+        return (struct.pack(">i", 1) + s(topic) + struct.pack(">i", 1)
+                + struct.pack(">ih", pid, self.produce_error)
+                + struct.pack(">q", 0)
+                + struct.pack(">q", -1)                   # log_append_time
+                + struct.pack(">i", 0))                   # throttle
+
+
+def decode_record_batch(batch: bytes):
+    """Validate framing + CRC and pull out (key, value) of record 0."""
+    base_offset, batch_len, _epoch, magic = struct.unpack_from(">qiib",
+                                                               batch, 0)
+    assert magic == 2
+    (crc,) = struct.unpack_from(">I", batch, 17)
+    body = batch[21:]
+    assert crc == crc32c(body), "batch CRC32C mismatch"
+    (n_records,) = struct.unpack_from(">i", body, 36)
+    pos = 40
+    _rec_len, pos = read_varint(body, pos)
+    pos += 1                                             # attributes
+    _ts_delta, pos = read_varint(body, pos)
+    _off_delta, pos = read_varint(body, pos)
+    klen, pos = read_varint(body, pos)
+    key = body[pos:pos + klen]
+    pos += klen
+    vlen, pos = read_varint(body, pos)
+    value = body[pos:pos + vlen]
+    return n_records, key, value
+
+
+@pytest.fixture()
+def broker():
+    b = _FakeBroker()
+    yield b
+    b.stop()
+
+
+def _event():
+    return filer_pb2.EventNotification(
+        new_entry=filer_pb2.Entry(name="k.txt"), new_parent_path="/d")
+
+
+def test_fnv1a_known_vectors():
+    assert fnv1a_32(b"") == 0x811C9DC5
+    assert fnv1a_32(b"a") == 0xE40C292C
+    assert fnv1a_32(b"foobar") == 0xBF9CF968
+
+
+def test_partitioner_is_stable_and_in_range():
+    for key in (b"/a", b"/b/c", b"x" * 100):
+        p = partition_for_key(key, 7)
+        assert 0 <= p < 7
+        assert p == partition_for_key(key, 7)
+
+
+def test_produce_roundtrip(broker):
+    q = KafkaQueue(hosts=[broker.host], topic="events")
+    assert sorted(q.partition_leaders) == [0, 1]
+    ev = _event()
+    q.send_message("/d/k.txt", ev)
+    assert len(broker.produced) == 1
+    topic, pid, batch = broker.produced[0]
+    assert topic == "events"
+    assert pid == partition_for_key(b"/d/k.txt", 2)
+    n, key, value = decode_record_batch(batch)
+    assert n == 1 and key == b"/d/k.txt"
+    got = filer_pb2.EventNotification()
+    got.ParseFromString(value)
+    assert got.new_entry.name == "k.txt"
+    assert got.new_parent_path == "/d"
+    q.close()
+
+
+def test_produce_error_raises(broker):
+    broker.produce_error = 6                      # NOT_LEADER_FOR_PARTITION
+    q = KafkaQueue(hosts=[broker.host], topic="events")
+    with pytest.raises(KafkaError, match="error code 6"):
+        q.send_message("/d/k.txt", _event())
+    q.close()
+
+
+def test_unreachable_broker_fails_loudly():
+    with pytest.raises(KafkaError, match="no kafka broker reachable"):
+        KafkaQueue(hosts=["127.0.0.1:1"], topic="events", timeout=0.5)
+
+
+def test_hosts_accepts_comma_string(broker):
+    q = KafkaQueue(hosts=f"{broker.host}, 127.0.0.1:1", topic="events")
+    assert q.partition_leaders
+    q.close()
+
+
+def test_from_config_builds_kafka(broker):
+    from seaweedfs_tpu import notification
+    from seaweedfs_tpu.util.config import Configuration
+    q = notification.from_config(Configuration({"notification": {
+        "kafka": {"enabled": True, "hosts": [broker.host],
+                  "topic": "events"}}}))
+    assert isinstance(q, KafkaQueue)
+    q.close()
+
+
+def test_record_batch_shape():
+    batch = encode_record_batch(b"key", b"value", 1234)
+    n, key, value = decode_record_batch(batch)
+    assert (n, key, value) == (1, b"key", b"value")
+
+
+def test_partitioning_uses_total_partition_count():
+    """A leaderless partition must NOT shrink the hash space — that
+    would remap every key while one broker is down."""
+    b = _FakeBroker(partitions=4, leaderless=(3,))
+    try:
+        q = KafkaQueue(hosts=[b.host], topic="events")
+        assert q.num_partitions == 4
+        assert sorted(q.partition_leaders) == [0, 1, 2]
+        # a key mapping to a live partition still produces fine
+        key = next(f"/k{i}" for i in range(100)
+                   if partition_for_key(f"/k{i}".encode(), 4) == 1)
+        q.send_message(key, _event())
+        assert b.produced[0][1] == 1
+        # a key mapping to the leaderless partition fails loudly
+        # instead of silently landing elsewhere
+        dead = next(f"/k{i}" for i in range(100)
+                    if partition_for_key(f"/k{i}".encode(), 4) == 3)
+        with pytest.raises(KafkaError, match="no leader"):
+            q.send_message(dead, _event())
+        q.close()
+    finally:
+        b.stop()
+
+
+def test_retriable_produce_error_refreshes_and_retries(broker):
+    """NOT_LEADER_FOR_PARTITION must trigger one metadata refresh and a
+    retry, not a dropped event."""
+    q = KafkaQueue(hosts=[broker.host], topic="events")
+    broker.produce_error = 6
+    calls = {"n": 0}
+    orig = broker._produce_response
+
+    def flaky(body):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            broker.produce_error = 0   # "new leader" accepts
+        return orig(body)
+    broker._produce_response = flaky
+    q.send_message("/d/k.txt", _event())
+    assert calls["n"] == 2             # failed once, retried once
+    q.close()
+
+
+def test_concurrent_sends_share_connection_safely(broker):
+    """ThreadingHTTPServer filers publish concurrently; frames on the
+    shared socket must not interleave."""
+    import threading as _t
+    q = KafkaQueue(hosts=[broker.host], topic="events")
+    errors = []
+
+    def send(i):
+        try:
+            q.send_message(f"/c/{i}.txt", _event())
+        except Exception as e:   # noqa: BLE001
+            errors.append(e)
+    threads = [_t.Thread(target=send, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(broker.produced) == 16
+    keys = set()
+    for _topic, _pid, batch in broker.produced:
+        _n, key, _v = decode_record_batch(batch)
+        keys.add(key.decode())
+    assert keys == {f"/c/{i}.txt" for i in range(16)}
+    q.close()
