@@ -287,6 +287,14 @@ path = "/tmp/seaweedfs_events.log"
 [notification.memory]
 enabled = false
 
+# Google Cloud Pub/Sub over REST (no SDK needed): service-account
+# OAuth via a stdlib RS256 JWT; topic auto-created if missing.
+[notification.google_pub_sub]
+enabled = false
+google_application_credentials = ""   # or GOOGLE_APPLICATION_CREDENTIALS
+project_id = ""                       # defaults to the one in the creds
+topic = "seaweedfs_filer"
+
 # Kafka over the binary wire protocol (no SDK needed): Metadata +
 # Produce v3 with record batches, sarama-compatible key partitioning.
 [notification.kafka]

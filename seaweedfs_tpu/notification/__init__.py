@@ -93,12 +93,18 @@ def _kafka_factory(**kw) -> MessageQueue:
     return KafkaQueue(**kw)
 
 
+def _pubsub_factory(**kw) -> MessageQueue:
+    from seaweedfs_tpu.notification.google_pub_sub import \
+        GooglePubSubQueue
+    return GooglePubSubQueue(**kw)
+
+
 _REGISTRY: Dict[str, Callable[..., MessageQueue]] = {
     "memory": MemoryQueue,
     "log": LogQueue,
-    "kafka": _kafka_factory,       # binary wire protocol, no SDK needed
-    "aws_sqs": _aws_sqs_factory,   # SigV4 over HTTP, no SDK needed
-    "google_pub_sub": _gated("google_pub_sub", "google-cloud-pubsub"),
+    "kafka": _kafka_factory,        # binary wire protocol, no SDK needed
+    "aws_sqs": _aws_sqs_factory,    # SigV4 over HTTP, no SDK needed
+    "google_pub_sub": _pubsub_factory,  # REST + RS256 JWT, no SDK needed
     "gocdk_pub_sub": _gated("gocdk_pub_sub", "a Go CDK bridge"),
 }
 

@@ -324,13 +324,14 @@ def fs_meta_notify(env, argv, out):
             "no enabled [notification.*] section in notification.toml")
     dirs = files = 0
 
+    from seaweedfs_tpu.filer.filer_notify import event_key
+
     def publish(directory: str):
         nonlocal dirs, files
         for entry in env.list_filer_entries(directory):
-            queue.send_message(
-                posixpath.join(directory, entry.name),
-                filer_pb2.EventNotification(new_entry=entry,
-                                            new_parent_path=directory))
+            ev = filer_pb2.EventNotification(new_entry=entry,
+                                             new_parent_path=directory)
+            queue.send_message(event_key(directory, ev), ev)
             if entry.is_directory:
                 dirs += 1
                 publish(posixpath.join(directory, entry.name))
@@ -338,4 +339,6 @@ def fs_meta_notify(env, argv, out):
                 files += 1
 
     publish(env.resolve_path(path))
+    if hasattr(queue, "flush"):
+        queue.flush()   # async backends: drain before reporting done
     print(f"notified {dirs} directories, {files} files", file=out)

@@ -56,9 +56,9 @@ class TestNotification:
         assert isinstance(new_queue("memory"), MemoryQueue)
         assert isinstance(
             new_queue("log", path=str(tmp_path / "l.log")), LogQueue)
-        # google_pub_sub is registered but gated on its missing SDK
-        with pytest.raises(RuntimeError, match="google_pub_sub"):
-            new_queue("google_pub_sub")
+        # gocdk_pub_sub is registered but gated (Go-only bridge)
+        with pytest.raises(RuntimeError, match="gocdk_pub_sub"):
+            new_queue("gocdk_pub_sub")
         # kafka is real now (wire protocol) but needs a reachable broker
         with pytest.raises(ValueError, match="hosts"):
             new_queue("kafka")
@@ -291,8 +291,8 @@ def test_sink_registry_and_gated_backends():
     sink = make_sink("azure", account_name="a", account_key="a2V5",
                      container="c")
     assert sink.container == "c"
-    with _pytest.raises(RuntimeError, match="google_pub_sub"):
-        notification.new_queue("google_pub_sub")
+    with _pytest.raises(RuntimeError, match="gocdk_pub_sub"):
+        notification.new_queue("gocdk_pub_sub")
 
 
 class TestMessagingChannelsAndCluster:

@@ -192,6 +192,7 @@ def test_produce_roundtrip(broker):
     assert sorted(q.partition_leaders) == [0, 1]
     ev = _event()
     q.send_message("/d/k.txt", ev)
+    assert q.flush(10)
     assert len(broker.produced) == 1
     topic, pid, batch = broker.produced[0]
     assert topic == "events"
@@ -205,11 +206,12 @@ def test_produce_roundtrip(broker):
     q.close()
 
 
-def test_produce_error_raises(broker):
+def test_produce_error_surfaces_on_last_error(broker):
     broker.produce_error = 6                      # NOT_LEADER_FOR_PARTITION
     q = KafkaQueue(hosts=[broker.host], topic="events")
-    with pytest.raises(KafkaError, match="error code 6"):
-        q.send_message("/d/k.txt", _event())
+    q.send_message("/d/k.txt", _event())
+    assert q.flush(10)
+    assert q.last_error is not None and "error code 6" in str(q.last_error)
     q.close()
 
 
@@ -252,13 +254,15 @@ def test_partitioning_uses_total_partition_count():
         key = next(f"/k{i}" for i in range(100)
                    if partition_for_key(f"/k{i}".encode(), 4) == 1)
         q.send_message(key, _event())
+        assert q.flush(10)
         assert b.produced[0][1] == 1
-        # a key mapping to the leaderless partition fails loudly
-        # instead of silently landing elsewhere
+        # a key mapping to the leaderless partition fails (recorded on
+        # last_error) instead of silently landing elsewhere
         dead = next(f"/k{i}" for i in range(100)
                     if partition_for_key(f"/k{i}".encode(), 4) == 3)
-        with pytest.raises(KafkaError, match="no leader"):
-            q.send_message(dead, _event())
+        q.send_message(dead, _event())
+        assert q.flush(10)
+        assert "no leader" in str(q.last_error)
         q.close()
     finally:
         b.stop()
@@ -279,6 +283,8 @@ def test_retriable_produce_error_refreshes_and_retries(broker):
         return orig(body)
     broker._produce_response = flaky
     q.send_message("/d/k.txt", _event())
+    assert q.flush(10)
+    assert q.last_error is None
     assert calls["n"] == 2             # failed once, retried once
     q.close()
 
@@ -301,6 +307,7 @@ def test_concurrent_sends_share_connection_safely(broker):
     for t in threads:
         t.join()
     assert not errors
+    assert q.flush(20)
     assert len(broker.produced) == 16
     keys = set()
     for _topic, _pid, batch in broker.produced:
