@@ -84,6 +84,39 @@ class MessageBroker:
             messaging_pb2, "SeaweedMessaging", self)
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
+        if self.filer_url:
+            # advertise ourselves + owned topics over the filer's
+            # KeepConnected stream so LocateBroker finds us (reference
+            # broker_server.go keepConnectedToOneFiler)
+            self._reg_thread = threading.Thread(
+                target=self._register_loop, name="broker-register",
+                daemon=True)
+            self._reg_thread.start()
+
+    def _register_loop(self) -> None:
+        def requests():
+            while not self._stopping:
+                with self._lock:
+                    resources = [f"{ns}/{t}" for ns, t in self._topics]
+                yield filer_pb2.KeepConnectedRequest(
+                    name="msgbroker",
+                    grpc_port=self.port + rpc.GRPC_PORT_OFFSET,
+                    resources=resources)
+                for _ in range(10):   # ~2s cadence, fast stop
+                    if self._stopping:
+                        return
+                    time.sleep(0.2)
+
+        while not self._stopping:
+            try:
+                for _resp in filer_stub(self.filer_url).KeepConnected(
+                        requests()):
+                    if self._stopping:
+                        return
+            except grpc.RpcError:
+                if self._stopping:
+                    return
+                time.sleep(1.0)
 
     def stop(self) -> None:
         self._stopping = True

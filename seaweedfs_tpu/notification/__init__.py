@@ -122,15 +122,103 @@ def new_queue(name: str, **kwargs) -> MessageQueue:
     return factory(**kwargs)
 
 
+# backends that talk to an external service get the async wrapper so
+# their outages never stall the filer write path
+_REMOTE = frozenset({"kafka", "aws_sqs", "google_pub_sub",
+                     "gocdk_pub_sub"})
+
+
 def from_config(conf) -> Optional[MessageQueue]:
     """Build the queue from a notification.toml Configuration: the
     first enabled [notification.X] section wins, its remaining keys
     become factory kwargs (reference notification.LoadConfiguration,
-    weed/notification/configuration.go)."""
+    weed/notification/configuration.go). Remote backends come back
+    wrapped in AsyncQueue."""
     sections = (conf.get("notification") or {}) if conf else {}
     for name, props in sections.items():
         if not isinstance(props, dict) or not props.get("enabled"):
             continue
         kwargs = {k: v for k, v in props.items() if k != "enabled"}
-        return new_queue(name, **kwargs)
+        q = new_queue(name, **kwargs)
+        return AsyncQueue(q) if name in _REMOTE else q
     return None
+
+
+class AsyncQueue(MessageQueue):
+    """Non-blocking wrapper for remote backends: send_message enqueues
+    into a bounded buffer and a sender thread does the wire work, so a
+    dead broker/endpoint stalls the publisher thread, not the filer
+    write path (the reference gets this from sarama's AsyncProducer for
+    kafka; here every remote backend rides the same mechanism). When
+    the buffer is full the OLDEST event is dropped and counted."""
+
+    MAX_PENDING = 1024
+
+    def __init__(self, inner: MessageQueue):
+        import collections
+        self.inner = inner
+        self._pending = collections.deque()
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._closed = False
+        self.dropped = 0
+        self.last_error: Optional[Exception] = None
+        self._sender = threading.Thread(target=self._run,
+                                        name="notify-sender", daemon=True)
+        self._sender.start()
+
+    def send_message(self, key, event) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("notification queue is closed")
+            if len(self._pending) >= self.MAX_PENDING:
+                self._pending.popleft()
+                self.dropped += 1
+            self._pending.append((key, event))
+            self._cv.notify()
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Block until everything enqueued so far is delivered (or
+        failed); False on timeout."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._pending or self._inflight:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(min(left, 0.05))
+        return True
+
+    def _run(self) -> None:
+        from seaweedfs_tpu.util import wlog
+        log = wlog.logger("notify")
+        while True:
+            with self._cv:
+                while not self._pending and not self._closed:
+                    self._cv.wait()
+                if not self._pending and self._closed:
+                    return
+                key, event = self._pending.popleft()
+                self._inflight += 1
+            try:
+                self.inner.send_message(key, event)
+                with self._cv:
+                    self.last_error = None
+            except Exception as e:   # noqa: BLE001 — any backend error
+                with self._cv:
+                    self.last_error = e
+                log.warning("notification publish failed, event "
+                            "dropped: %s", e)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._sender.join(timeout=30.0)
+        if hasattr(self.inner, "close"):
+            self.inner.close()

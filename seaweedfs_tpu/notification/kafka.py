@@ -165,10 +165,11 @@ def encode_record_batch(key: bytes, value: bytes, timestamp_ms: int) -> bytes:
     return header + body
 
 
-MAX_PENDING = 1024   # bounded async buffer; oldest dropped beyond this
-
-
 class KafkaQueue(MessageQueue):
+    """Synchronous wire client; production configs get wrapped in
+    notification.AsyncQueue by from_config so a down broker stalls the
+    sender thread, not the filer write path."""
+
     def __init__(self, hosts=None, topic: str = "seaweedfs_filer",
                  client_id: str = "seaweedfs-tpu",
                  timeout: float = 10.0, **_ignored):
@@ -182,27 +183,14 @@ class KafkaQueue(MessageQueue):
         self.timeout = timeout
         self._corr = 0
         # one lock serializes all wire traffic: connections are shared
-        # per broker and the sender/metadata paths touch shared state
+        # per broker and concurrent callers touch shared state
         self._lock = threading.Lock()
         self._conns: Dict[str, socket.socket] = {}
-        # leader discovery up front, like sarama's NewAsyncProducer
+        # leader discovery up front, like sarama's producer
         self.partition_leaders: Dict[int, str] = {}
         self.num_partitions = 0   # TOTAL partitions (even leaderless)
         with self._lock:
             self._refresh_metadata()
-        # async sender (reference kafka_queue.go uses NewAsyncProducer):
-        # a down broker must stall the publisher thread, not every
-        # filer namespace operation
-        import collections
-        self._pending = collections.deque()
-        self._cv = threading.Condition()
-        self._inflight = 0
-        self._closed = False
-        self.dropped = 0
-        self.last_error: Optional[Exception] = None
-        self._sender = threading.Thread(target=self._sender_loop,
-                                        name="kafka-sender", daemon=True)
-        self._sender.start()
 
     # -- framing --------------------------------------------------------------
 
@@ -336,58 +324,8 @@ class KafkaQueue(MessageQueue):
     _RETRIABLE = (5, 6)   # LEADER_NOT_AVAILABLE, NOT_LEADER_FOR_PARTITION
 
     def send_message(self, key, event) -> None:
-        """Enqueue and return; the sender thread does the wire work.
-        When the buffer is full the OLDEST event is dropped (counted in
-        .dropped) — a dead broker must not stall filer writes."""
-        with self._cv:
-            if self._closed:
-                raise KafkaError("kafka queue is closed")
-            if len(self._pending) >= MAX_PENDING:
-                self._pending.popleft()
-                self.dropped += 1
-            self._pending.append((key.encode(),
-                                  event.SerializeToString()))
-            self._cv.notify()
-
-    def flush(self, timeout: float = 10.0) -> bool:
-        """Block until everything enqueued so far is on the wire (or
-        failed); False on timeout."""
         import time
-        deadline = time.monotonic() + timeout
-        with self._cv:
-            while self._pending or self._inflight:
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    return False
-                self._cv.wait(min(left, 0.05))
-        return True
-
-    def _sender_loop(self) -> None:
-        from seaweedfs_tpu.util import wlog
-        log = wlog.logger("kafka")
-        while True:
-            with self._cv:
-                while not self._pending and not self._closed:
-                    self._cv.wait()
-                if not self._pending and self._closed:
-                    return
-                kb, value = self._pending.popleft()
-                self._inflight += 1
-            try:
-                self._send_one(kb, value)
-                with self._cv:
-                    self.last_error = None
-            except (KafkaError, OSError) as e:
-                with self._cv:
-                    self.last_error = e
-                log.warning("kafka publish failed, event dropped: %s", e)
-            finally:
-                with self._cv:
-                    self._inflight -= 1
-                    self._cv.notify_all()
-
-    def _send_one(self, kb: bytes, value: bytes) -> None:
-        import time
+        kb, value = key.encode(), event.SerializeToString()
         with self._lock:
             if not self.num_partitions:
                 self._refresh_metadata()
@@ -434,10 +372,6 @@ class KafkaQueue(MessageQueue):
             raise e
 
     def close(self) -> None:
-        with self._cv:
-            self._closed = True
-            self._cv.notify_all()
-        self._sender.join(timeout=self.timeout + 1)
         with self._lock:
             for host in list(self._conns):
                 self._drop(host)

@@ -161,3 +161,13 @@ def make_server(address: str, handlers, max_workers: int = 16) -> grpc.Server:
     server.bound_port = bound  # OS-assigned when address ends in :0
     server.start()
     return server
+
+
+def peer_ip(context, default: str = "127.0.0.1") -> str:
+    """Client IP from a gRPC ServicerContext ("ipv4:1.2.3.4:567",
+    "ipv6:[::1]:567", "unix:..." -> default)."""
+    peer = context.peer() or ""
+    if peer.startswith(("ipv4:", "ipv6:")):
+        host = peer.split(":", 1)[1].rsplit(":", 1)[0]
+        return host.strip("[]") or default
+    return default

@@ -113,8 +113,10 @@ class FilerServer:
             c.is_compressed)
         self.chunk_cache = TieredChunkCache(
             disk_dir=f"{cache_dir}/chunks" if cache_dir else None)
+        from seaweedfs_tpu.rpc import GRPC_PORT_OFFSET
         self.master_client = MasterClient(
-            [master_url], client_name=f"filer@{ip}:{port}")
+            [master_url], client_name="filer",
+            grpc_port=port + GRPC_PORT_OFFSET)
         # path-specific rules (/etc/seaweedfs/filer.conf inside the
         # namespace; reference filer_conf.go) — loaded lazily, reloaded
         # whenever that path is written through this filer
@@ -145,6 +147,9 @@ class FilerServer:
         self._http_server = None
         self._http_thread = None
         self._stopping = False
+        # live KeepConnected peers: (name, grpc_addr) -> [resources]
+        self._brokers: dict = {}
+        self._broker_lock = threading.Lock()
 
     def _maybe_reload_conf(self, *paths: str) -> None:
         if filer_conf_mod.FILER_CONF_PATH in paths:
@@ -426,6 +431,51 @@ class FilerServer:
                 since = max(since, ev.ts_ns)
             if not events:
                 self.filer.meta_log.wait_for_data(since, timeout=0.5)
+
+    # -- gRPC: broker registration / discovery --------------------------------
+
+    def KeepConnected(self, request_iterator, context):
+        """Peers (message brokers) hold this stream open, advertising
+        their gRPC address and owned resources; LocateBroker answers
+        from the live set (reference filer_grpc_server.go
+        KeepConnected/LocateBroker)."""
+        from seaweedfs_tpu.rpc import peer_ip
+        key = None
+        try:
+            for req in request_iterator:
+                new_key = (req.name,
+                           f"{peer_ip(context)}:{req.grpc_port}")
+                with self._broker_lock:
+                    if key is not None and key != new_key:
+                        # re-advertised identity: drop the old entry so
+                        # LocateBroker never returns a dead address
+                        self._brokers.pop(key, None)
+                    key = new_key
+                    self._brokers[key] = list(req.resources)
+                yield filer_pb2.KeepConnectedResponse()
+                if not context.is_active() or self._stopping:
+                    break
+        finally:
+            if key is not None:
+                with self._broker_lock:
+                    self._brokers.pop(key, None)
+
+    def LocateBroker(self, request, context):
+        with self._broker_lock:
+            brokers = {addr: res for (_n, addr), res
+                       in self._brokers.items()}
+        for addr, resources in brokers.items():
+            if request.resource in resources:
+                return filer_pb2.LocateBrokerResponse(
+                    found=True,
+                    resources=[filer_pb2.LocateBrokerResponse.Resource(
+                        grpc_addresses=addr,
+                        resource_count=len(resources))])
+        return filer_pb2.LocateBrokerResponse(
+            found=False,
+            resources=[filer_pb2.LocateBrokerResponse.Resource(
+                grpc_addresses=addr, resource_count=len(res))
+                for addr, res in sorted(brokers.items())])
 
     # -- gRPC: KV -------------------------------------------------------------
 

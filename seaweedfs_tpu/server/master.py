@@ -134,6 +134,7 @@ class MasterServer:
         self._node_streams: Dict[str, object] = {}
         # KeepConnected subscribers: name -> queue of VolumeLocation
         self._subscribers: Dict[int, queue.Queue] = {}
+        self._client_addrs: Dict[int, tuple] = {}  # key -> (type, addr)
         self._sub_seq = 0
         self._sub_lock = threading.Lock()
         self._stopping = False
@@ -393,18 +394,22 @@ class MasterServer:
 
     def KeepConnected(self, request_iterator, context):
         try:
-            next(request_iterator)  # client introduces itself
+            intro = next(request_iterator)  # client introduces itself
         except StopIteration:
             return
         if not self.raft.is_leader:
             yield master_pb2.VolumeLocation(
                 leader=self.raft.leader() or "")
             return
+        # remember who's connected for ListMasterClients (reference
+        # master_grpc_server.go clientChans keyed by "<type>@<addr>")
+        client_addr = f"{rpc.peer_ip(context)}:{intro.grpc_port}"
         q: queue.Queue = queue.Queue()
         with self._sub_lock:
             self._sub_seq += 1
             key = self._sub_seq
             self._subscribers[key] = q
+            self._client_addrs[key] = (intro.name, client_addr)
         try:
             yield master_pb2.VolumeLocation(leader=self.url)
             for loc in self._full_locations():
@@ -417,6 +422,16 @@ class MasterServer:
         finally:
             with self._sub_lock:
                 self._subscribers.pop(key, None)
+                self._client_addrs.pop(key, None)
+
+    def ListMasterClients(self, request, context):
+        """Reference master_grpc_server.go ListMasterClients: the gRPC
+        addresses of live KeepConnected clients of one type (the name
+        the client introduced itself with, e.g. "filer", "brk")."""
+        with self._sub_lock:
+            addrs = [addr for name, addr in self._client_addrs.values()
+                     if name == request.client_type]
+        return master_pb2.ListMasterClientsResponse(grpc_addresses=addrs)
 
     def LookupVolume(self, request, context):
         out = []

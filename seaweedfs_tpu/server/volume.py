@@ -715,6 +715,41 @@ class VolumeServer:
             self._hb_call.cancel()
         return volume_server_pb2.VolumeServerLeaveResponse()
 
+    def VolumeStatus(self, request, context):
+        """Liveness/readonly probe (reference volume_grpc_admin.go
+        VolumeStatus)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        return volume_server_pb2.VolumeStatusResponse(
+            is_read_only=v.read_only)
+
+    def VolumeNeedleStatus(self, request, context):
+        """One needle's metadata without its data (reference
+        volume_grpc_query.go VolumeNeedleStatus): index entry + the
+        stored record's mtime/crc."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        nv = v.nm.get(request.needle_id)
+        if nv is None or not t.size_is_valid(nv.size):
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"needle {request.needle_id} not found")
+        # cookie=0 skips the cookie check — this is an admin probe
+        try:
+            got = v.read_needle(Needle(id=request.needle_id, cookie=0))
+        except NeedleError as e:   # expired / torn / CRC-bad record
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return volume_server_pb2.VolumeNeedleStatusResponse(
+            needle_id=request.needle_id,
+            cookie=got.cookie,
+            size=nv.size,
+            last_modified=got.append_at_ns // 1_000_000_000,
+            crc=got.checksum,
+            ttl=str(v.ttl))
+
     def VolumeConfigure(self, request, context):
         """Rewrite a volume's replica placement in its superblock
         (reference server/volume_grpc_admin.go:104)."""

@@ -19,11 +19,13 @@ from seaweedfs_tpu.wdclient.vid_map import Location, VidMap
 
 
 class MasterClient:
-    def __init__(self, masters: List[str], client_name: str = "client"):
+    def __init__(self, masters: List[str], client_name: str = "client",
+                 grpc_port: int = 0):
         if not masters:
             raise ValueError("need at least one master address")
         self.masters = masters
         self.client_name = client_name
+        self.grpc_port = grpc_port  # advertised via ListMasterClients
         self.current_master = masters[0]
         self.vid_map = VidMap()
         self._stop = threading.Event()
@@ -66,7 +68,8 @@ class MasterClient:
     def _follow(self, target: str) -> None:
         stub = master_stub(target)
         self._stream = stub.KeepConnected(iter(
-            [master_pb2.KeepConnectedRequest(name=self.client_name)]))
+            [master_pb2.KeepConnectedRequest(name=self.client_name,
+                                             grpc_port=self.grpc_port)]))
         for loc in self._stream:
             if self._stop.is_set():
                 return

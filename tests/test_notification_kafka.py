@@ -192,7 +192,6 @@ def test_produce_roundtrip(broker):
     assert sorted(q.partition_leaders) == [0, 1]
     ev = _event()
     q.send_message("/d/k.txt", ev)
-    assert q.flush(10)
     assert len(broker.produced) == 1
     topic, pid, batch = broker.produced[0]
     assert topic == "events"
@@ -206,12 +205,11 @@ def test_produce_roundtrip(broker):
     q.close()
 
 
-def test_produce_error_surfaces_on_last_error(broker):
+def test_produce_error_raises(broker):
     broker.produce_error = 6                      # NOT_LEADER_FOR_PARTITION
     q = KafkaQueue(hosts=[broker.host], topic="events")
-    q.send_message("/d/k.txt", _event())
-    assert q.flush(10)
-    assert q.last_error is not None and "error code 6" in str(q.last_error)
+    with pytest.raises(KafkaError, match="error code 6"):
+        q.send_message("/d/k.txt", _event())
     q.close()
 
 
@@ -232,7 +230,9 @@ def test_from_config_builds_kafka(broker):
     q = notification.from_config(Configuration({"notification": {
         "kafka": {"enabled": True, "hosts": [broker.host],
                   "topic": "events"}}}))
-    assert isinstance(q, KafkaQueue)
+    from seaweedfs_tpu.notification import AsyncQueue
+    assert isinstance(q, AsyncQueue)      # remote backends are wrapped
+    assert isinstance(q.inner, KafkaQueue)
     q.close()
 
 
@@ -254,15 +254,13 @@ def test_partitioning_uses_total_partition_count():
         key = next(f"/k{i}" for i in range(100)
                    if partition_for_key(f"/k{i}".encode(), 4) == 1)
         q.send_message(key, _event())
-        assert q.flush(10)
         assert b.produced[0][1] == 1
-        # a key mapping to the leaderless partition fails (recorded on
-        # last_error) instead of silently landing elsewhere
+        # a key mapping to the leaderless partition fails loudly
+        # instead of silently landing elsewhere
         dead = next(f"/k{i}" for i in range(100)
                     if partition_for_key(f"/k{i}".encode(), 4) == 3)
-        q.send_message(dead, _event())
-        assert q.flush(10)
-        assert "no leader" in str(q.last_error)
+        with pytest.raises(KafkaError, match="no leader"):
+            q.send_message(dead, _event())
         q.close()
     finally:
         b.stop()
@@ -283,8 +281,6 @@ def test_retriable_produce_error_refreshes_and_retries(broker):
         return orig(body)
     broker._produce_response = flaky
     q.send_message("/d/k.txt", _event())
-    assert q.flush(10)
-    assert q.last_error is None
     assert calls["n"] == 2             # failed once, retried once
     q.close()
 
@@ -307,7 +303,6 @@ def test_concurrent_sends_share_connection_safely(broker):
     for t in threads:
         t.join()
     assert not errors
-    assert q.flush(20)
     assert len(broker.produced) == 16
     keys = set()
     for _topic, _pid, batch in broker.produced:
@@ -315,3 +310,57 @@ def test_concurrent_sends_share_connection_safely(broker):
         keys.add(key.decode())
     assert keys == {f"/c/{i}.txt" for i in range(16)}
     q.close()
+
+
+def test_async_queue_wraps_kafka_and_buffers(broker):
+    """from_config wraps remote backends in AsyncQueue: sends are
+    non-blocking, failures land on last_error, drops are counted."""
+    from seaweedfs_tpu import notification
+    from seaweedfs_tpu.util.config import Configuration
+    q = notification.from_config(Configuration({"notification": {
+        "kafka": {"enabled": True, "hosts": [broker.host],
+                  "topic": "events"}}}))
+    assert isinstance(q, notification.AsyncQueue)
+    assert isinstance(q.inner, KafkaQueue)
+    for i in range(4):
+        q.send_message(f"/a/{i}", _event())
+    assert q.flush(10)
+    assert len(broker.produced) == 4 and q.last_error is None
+    q.close()
+
+
+def test_async_queue_drops_oldest_and_records_errors():
+    from seaweedfs_tpu.notification import AsyncQueue, MessageQueue
+
+    class Stuck(MessageQueue):
+        def __init__(self):
+            import threading
+            self.gate = threading.Event()
+            self.sent = []
+
+        def send_message(self, key, event):
+            self.gate.wait(10)
+            if key == "/boom":
+                raise RuntimeError("backend exploded")
+            self.sent.append(key)
+
+    inner = Stuck()
+    q = AsyncQueue(inner)
+    q.MAX_PENDING = 4
+    try:
+        q.send_message("/first", _event())   # sender grabs this, blocks
+        import time
+        time.sleep(0.1)
+        for i in range(6):                   # 6 > MAX_PENDING=4
+            q.send_message(f"/k{i}", _event())
+        assert q.dropped == 2                # oldest two evicted
+        q.send_message("/boom", _event())
+        assert q.dropped == 3
+        inner.gate.set()
+        assert q.flush(10)
+        assert q.last_error is not None
+        assert "exploded" in str(q.last_error)
+        # the non-dropped, non-failing keys all made it, in order
+        assert inner.sent == ["/first", "/k3", "/k4", "/k5"]
+    finally:
+        q.close()

@@ -232,4 +232,7 @@ def test_from_config_builds_pubsub(goog, creds_file):
             "enabled": True,
             "google_application_credentials": creds_file,
             "topic": "cfg", "endpoint": goog.url}}}))
-    assert isinstance(q, GooglePubSubQueue)
+    from seaweedfs_tpu.notification import AsyncQueue
+    assert isinstance(q, AsyncQueue)      # remote backends are wrapped
+    assert isinstance(q.inner, GooglePubSubQueue)
+    q.close()

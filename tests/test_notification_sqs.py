@@ -137,7 +137,10 @@ def test_from_config_picks_first_enabled(tmp_path, sqs):
                     "aws_secret_access_key": "SK"},
     }})
     q = notification.from_config(conf)
-    assert isinstance(q, AwsSqsQueue)
+    from seaweedfs_tpu.notification import AsyncQueue
+    assert isinstance(q, AsyncQueue)      # remote backends are wrapped
+    assert isinstance(q.inner, AwsSqsQueue)
+    q.close()
     assert notification.from_config(None) is None
     assert notification.from_config(
         Configuration({"notification": {

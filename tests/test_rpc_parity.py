@@ -1,0 +1,107 @@
+"""RPC-surface parity additions: ListMasterClients, filer
+KeepConnected/LocateBroker, VolumeStatus, VolumeNeedleStatus."""
+
+import pytest
+
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.pb import (filer_pb2, filer_stub, master_pb2,
+                              master_stub, volume_server_pb2, volume_stub)
+from tests.cluster_util import Cluster, free_port_pair
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("rpcparity"), n_volume_servers=1,
+                with_filer=True)
+    yield c
+    c.stop()
+
+
+def test_list_master_clients_sees_the_filer(cluster):
+    stub = master_stub(cluster.master.url)
+
+    def filer_listed():
+        resp = stub.ListMasterClients(
+            master_pb2.ListMasterClientsRequest(client_type="filer"))
+        return list(resp.grpc_addresses)
+    addrs = cluster.wait_for(filer_listed, what="filer in client list")
+    # the filer advertises its gRPC port (HTTP + 10000)
+    assert any(a.endswith(str(cluster.filer.port + 10000))
+               for a in addrs), addrs
+    # unknown type -> empty
+    resp = stub.ListMasterClients(
+        master_pb2.ListMasterClientsRequest(client_type="nope"))
+    assert not list(resp.grpc_addresses)
+
+
+def test_volume_status_and_needle_status(cluster):
+    fid = cluster.upload(b"needle status payload")
+    f = parse_fid(fid)
+    url = cluster.volume_servers[0].url
+    vs = volume_stub(url)
+
+    st = vs.VolumeStatus(volume_server_pb2.VolumeStatusRequest(
+        volume_id=f.volume_id))
+    assert st.is_read_only is False
+
+    ns = vs.VolumeNeedleStatus(volume_server_pb2.VolumeNeedleStatusRequest(
+        volume_id=f.volume_id, needle_id=f.key))
+    assert ns.needle_id == f.key
+    assert ns.cookie == f.cookie
+    # the index size covers the whole stored body (data+name+flags),
+    # like the reference's needle Size field
+    assert ns.size >= len(b"needle status payload")
+    assert ns.last_modified > 0
+    assert ns.crc != 0
+
+    import grpc
+    with pytest.raises(grpc.RpcError):
+        vs.VolumeNeedleStatus(volume_server_pb2.VolumeNeedleStatusRequest(
+            volume_id=f.volume_id, needle_id=0xDEAD))
+
+
+def test_broker_registers_and_locate_broker_finds_it(cluster, tmp_path):
+    from seaweedfs_tpu.messaging.broker import MessageBroker
+    from seaweedfs_tpu.messaging.client import MessagingClient
+
+    broker = MessageBroker(filer_url=cluster.filer.url,
+                           port=free_port_pair())
+    broker.peers = [broker.url]
+    broker.start()
+    try:
+        fstub = filer_stub(cluster.filer.url)
+
+        # registration stream comes up with an empty resource list
+        def registered():
+            resp = fstub.LocateBroker(
+                filer_pb2.LocateBrokerRequest(resource="nope"))
+            return list(resp.resources)
+        listed = cluster.wait_for(registered, what="broker registered")
+        assert not cluster.wait_for(registered, what="x")[0].resource_count
+
+        # publish -> topic owned -> LocateBroker finds the exact broker
+        client = MessagingClient(broker.url)
+        pub = client.new_publisher("chat", "room1")
+        pub.publish(b"hello")
+        pub.close()
+
+        def found():
+            resp = fstub.LocateBroker(
+                filer_pb2.LocateBrokerRequest(resource="chat/room1"))
+            return resp.found
+        cluster.wait_for(found, what="topic resource visible")
+        resp = fstub.LocateBroker(
+            filer_pb2.LocateBrokerRequest(resource="chat/room1"))
+        assert resp.found
+        assert resp.resources[0].grpc_addresses.endswith(
+            str(broker.port + 10000))
+        assert resp.resources[0].resource_count >= 1
+    finally:
+        broker.stop()
+
+    # after the broker stops, its stream drops and it disappears
+    def gone():
+        resp = filer_stub(cluster.filer.url).LocateBroker(
+            filer_pb2.LocateBrokerRequest(resource="chat/room1"))
+        return not resp.found and not resp.resources
+    cluster.wait_for(gone, what="broker deregistered")
