@@ -29,6 +29,18 @@ def _setup_tls(role: str) -> None:
     setup_client_tls(role)
 
 
+def _maybe_start_metrics(opts) -> None:
+    """Expose Prometheus text metrics on -metricsPort (reference
+    stats/metrics.go:172 StartMetricsServer; one shared registry per
+    process)."""
+    port = getattr(opts, "metrics_port", 0)
+    if port:
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+        srv = start_metrics_server(port)
+        grace.on_interrupt(srv.shutdown)
+        log.info("metrics exposed on :%d/metrics", port)
+
+
 def _serve_forever(stoppables: List) -> int:
     done = threading.Event()
     for s in stoppables:
@@ -67,6 +79,8 @@ def _master_parser() -> argparse.ArgumentParser:
                    help="comma-separated ip:port of ALL masters "
                         "(including this one) for raft HA")
     p.add_argument("-cpuprofile", default=None)
+    p.add_argument("-metricsPort", dest="metrics_port", type=int,
+                   default=0, help="Prometheus /metrics pull port")
     return p
 
 
@@ -105,6 +119,7 @@ def run_master(args) -> int:
     _setup_tls("master")
     opts = _master_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
+    _maybe_start_metrics(opts)
     m = _build_master(opts)
     m.start()
     return _serve_forever([m])
@@ -135,6 +150,8 @@ def _volume_parser() -> argparse.ArgumentParser:
                         "or kv (persistent LogKV, O(live) reopen; reference "
                         "command/volume.go:203-211 leveldb kinds)")
     p.add_argument("-cpuprofile", default=None)
+    p.add_argument("-metricsPort", dest="metrics_port", type=int,
+                   default=0, help="Prometheus /metrics pull port")
     return p
 
 
@@ -176,6 +193,7 @@ def run_volume(args) -> int:
     _setup_tls("volume")
     opts = _volume_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
+    _maybe_start_metrics(opts)
     vs = _build_volume(opts)
     vs.start()
     return _serve_forever([vs])
@@ -203,6 +221,8 @@ def _filer_parser() -> argparse.ArgumentParser:
     p.add_argument("-peers", default="",
                    help="comma-separated host:port of ALL filers in "
                         "this cluster (merged metadata view)")
+    p.add_argument("-metricsPort", dest="metrics_port", type=int,
+                   default=0, help="Prometheus /metrics pull port")
     return p
 
 
@@ -239,6 +259,7 @@ def _build_filer(opts):
 def run_filer(args) -> int:
     _setup_tls("filer")
     opts = _filer_parser().parse_args(args)
+    _maybe_start_metrics(opts)
     fs = _build_filer(opts)
     fs.start()
     return _serve_forever([fs])
@@ -271,12 +292,15 @@ def _s3_parser() -> argparse.ArgumentParser:
     p.add_argument("-filer", default="127.0.0.1:8888")
     p.add_argument("-config", default=None,
                    help="JSON file with IAM identities")
+    p.add_argument("-metricsPort", dest="metrics_port", type=int,
+                   default=0, help="Prometheus /metrics pull port")
     return p
 
 
 @command("s3", "start an S3-compatible gateway")
 def run_s3(args) -> int:
     opts = _s3_parser().parse_args(args)
+    _maybe_start_metrics(opts)
     from seaweedfs_tpu.s3api.server import S3ApiServer
     s3 = S3ApiServer(opts.filer, ip=opts.ip, port=opts.port,
                      iam=_load_iam(opts.config))

@@ -162,6 +162,7 @@ class AsyncQueue(MessageQueue):
         self._inflight = 0
         self._closed = False
         self.dropped = 0
+        self.failed = 0      # monotonic: sends the backend rejected
         self.last_error: Optional[Exception] = None
         self._sender = threading.Thread(target=self._run,
                                         name="notify-sender", daemon=True)
@@ -208,6 +209,7 @@ class AsyncQueue(MessageQueue):
             except Exception as e:   # noqa: BLE001 — any backend error
                 with self._cv:
                     self.last_error = e
+                    self.failed += 1
                 log.warning("notification publish failed, event "
                             "dropped: %s", e)
             finally:

@@ -441,28 +441,34 @@ class FilerServer:
         KeepConnected/LocateBroker)."""
         from seaweedfs_tpu.rpc import peer_ip
         key = None
+        token = object()   # this stream's ownership marker: a quickly
+        # reconnecting broker reuses the same (name, addr) key, and the
+        # OLD stream's teardown must not deregister the NEW stream
         try:
             for req in request_iterator:
                 new_key = (req.name,
                            f"{peer_ip(context)}:{req.grpc_port}")
                 with self._broker_lock:
                     if key is not None and key != new_key:
-                        # re-advertised identity: drop the old entry so
-                        # LocateBroker never returns a dead address
-                        self._brokers.pop(key, None)
+                        cur = self._brokers.get(key)
+                        if cur and cur[0] is token:
+                            # re-advertised identity: drop our old entry
+                            self._brokers.pop(key, None)
                     key = new_key
-                    self._brokers[key] = list(req.resources)
+                    self._brokers[key] = (token, list(req.resources))
                 yield filer_pb2.KeepConnectedResponse()
                 if not context.is_active() or self._stopping:
                     break
         finally:
             if key is not None:
                 with self._broker_lock:
-                    self._brokers.pop(key, None)
+                    cur = self._brokers.get(key)
+                    if cur and cur[0] is token:
+                        self._brokers.pop(key, None)
 
     def LocateBroker(self, request, context):
         with self._broker_lock:
-            brokers = {addr: res for (_n, addr), res
+            brokers = {addr: res for (_n, addr), (_tok, res)
                        in self._brokers.items()}
         for addr, resources in brokers.items():
             if request.resource in resources:

@@ -920,11 +920,29 @@ def parse_multipart(content_type: str, body: bytes):
 
 
 def _make_http_handler(vs: VolumeServer):
+    from seaweedfs_tpu.stats.metrics import (RequestCounter,
+                                             RequestHistogram)
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, fmt, *args):
             pass
+
+        def handle_one_request(self):
+            # Prometheus request counter + latency per HTTP verb
+            # (reference volume_server_handlers.go stats wrappers).
+            # Only count PARSED requests: probes that connect and close
+            # leave raw_requestline empty, and a keep-alive close would
+            # otherwise re-count the previous verb.
+            self.command = None
+            t0 = time.perf_counter()
+            super().handle_one_request()
+            if getattr(self, "raw_requestline", b"") and self.command:
+                verb = self.command.lower()
+                RequestCounter.labels("volumeServer", verb).inc()
+                RequestHistogram.labels("volumeServer", verb).observe(
+                    time.perf_counter() - t0)
 
         # -- plumbing ---------------------------------------------------------
 

@@ -338,19 +338,27 @@ def fs_meta_notify(env, argv, out):
             else:
                 files += 1
 
-    publish(env.resolve_path(path))
-    # async backends: drain before reporting, and be honest about any
-    # events the bounded buffer or the backend dropped
-    losses = []
-    if hasattr(queue, "flush") and not queue.flush(timeout=60.0):
-        losses.append("flush timed out with events still pending")
-    if getattr(queue, "dropped", 0):
-        losses.append(f"{queue.dropped} events dropped (buffer full)")
-    if getattr(queue, "last_error", None) is not None:
-        losses.append(f"last publish error: {queue.last_error}")
-    print(f"notified {dirs} directories, {files} files", file=out)
-    for loss in losses:
-        print(f"WARNING: {loss}", file=out)
-    if losses:
-        raise RuntimeError(
-            "not every event reached the queue: " + "; ".join(losses))
+    try:
+        publish(env.resolve_path(path))
+        # async backends: drain before reporting, and be honest about
+        # any events the bounded buffer or the backend dropped
+        losses = []
+        if hasattr(queue, "flush") and not queue.flush(timeout=60.0):
+            losses.append("flush timed out with events still pending")
+        if getattr(queue, "dropped", 0):
+            losses.append(f"{queue.dropped} events dropped "
+                          f"(buffer full)")
+        if getattr(queue, "failed", 0):
+            losses.append(
+                f"{queue.failed} publishes failed "
+                f"(last error: {queue.last_error})")
+        print(f"notified {dirs} directories, {files} files", file=out)
+        for loss in losses:
+            print(f"WARNING: {loss}", file=out)
+        if losses:
+            raise RuntimeError(
+                "not every event reached the queue: "
+                + "; ".join(losses))
+    finally:
+        if hasattr(queue, "close"):
+            queue.close()   # we built this queue; drop its sender/conns

@@ -31,6 +31,7 @@ class Store:
         # deltas queued for the next heartbeat
         self.new_volumes: List[dict] = []
         self.deleted_volumes: List[dict] = []
+        self._metric_collections: set = set()
         for loc in self.locations:
             loc.load_existing_volumes()
 
@@ -155,12 +156,32 @@ class Store:
         }
 
     def collect_heartbeat(self) -> dict:
+        from seaweedfs_tpu.stats.metrics import (
+            VolumeServerDiskSizeGauge, VolumeServerVolumeCounter)
         with self._lock:
             volumes = []
             ec_shards = []
+            sizes: dict = {}
             for loc in self.locations:
                 for v in loc.volumes.values():
                     volumes.append(self.volume_info(v))
+                    sizes[v.collection] = sizes.get(v.collection, 0) + \
+                        v.content_size
+            counts: dict = {}
+            for vi in volumes:
+                counts[vi["collection"]] = counts.get(vi["collection"],
+                                                      0) + 1
+            # zero collections that disappeared since the last pass, or
+            # dashboards keep showing a deleted collection's last value
+            for col in self._metric_collections - set(counts):
+                VolumeServerVolumeCounter.labels(col, "volume").set(0)
+                VolumeServerDiskSizeGauge.labels(col, "normal").set(0)
+            self._metric_collections = set(counts)
+            for col, n in counts.items():
+                VolumeServerVolumeCounter.labels(col, "volume").set(n)
+            for col, sz in sizes.items():
+                VolumeServerDiskSizeGauge.labels(col, "normal").set(sz)
+            for loc in self.locations:
                 for vid, ecv in loc.ec_volumes.items():
                     ec_shards.append({
                         "id": vid,

@@ -105,3 +105,16 @@ def test_broker_registers_and_locate_broker_finds_it(cluster, tmp_path):
             filer_pb2.LocateBrokerRequest(resource="chat/room1"))
         return not resp.found and not resp.resources
     cluster.wait_for(gone, what="broker deregistered")
+
+
+def test_request_metrics_recorded(cluster):
+    """Volume HTTP requests land in the shared Prometheus registry
+    (reference stats wrappers on the volume server handlers)."""
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+    cluster.upload(b"metric me")
+    cluster.volume_servers[0].store.collect_heartbeat()
+    text = REGISTRY.render()
+    assert 'SeaweedFS_request_total{type="volumeServer",name="post"}' \
+        in text
+    assert "SeaweedFS_volumeServer_volumes" in text
+    assert "SeaweedFS_request_seconds" in text
