@@ -163,7 +163,8 @@ class AsyncQueue(MessageQueue):
         self._closed = False
         self.dropped = 0
         self.failed = 0      # monotonic: sends the backend rejected
-        self.last_error: Optional[Exception] = None
+        self.last_error: Optional[Exception] = None   # None after success
+        self.last_failure: Optional[Exception] = None  # never reset
         self._sender = threading.Thread(target=self._run,
                                         name="notify-sender", daemon=True)
         self._sender.start()
@@ -209,6 +210,7 @@ class AsyncQueue(MessageQueue):
             except Exception as e:   # noqa: BLE001 — any backend error
                 with self._cv:
                     self.last_error = e
+                    self.last_failure = e
                     self.failed += 1
                 log.warning("notification publish failed, event "
                             "dropped: %s", e)

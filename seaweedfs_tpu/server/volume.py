@@ -929,20 +929,6 @@ def _make_http_handler(vs: VolumeServer):
         def log_message(self, fmt, *args):
             pass
 
-        def handle_one_request(self):
-            # Prometheus request counter + latency per HTTP verb
-            # (reference volume_server_handlers.go stats wrappers).
-            # Only count PARSED requests: probes that connect and close
-            # leave raw_requestline empty, and a keep-alive close would
-            # otherwise re-count the previous verb.
-            self.command = None
-            t0 = time.perf_counter()
-            super().handle_one_request()
-            if getattr(self, "raw_requestline", b"") and self.command:
-                verb = self.command.lower()
-                RequestCounter.labels("volumeServer", verb).inc()
-                RequestHistogram.labels("volumeServer", verb).observe(
-                    time.perf_counter() - t0)
 
         # -- plumbing ---------------------------------------------------------
 
@@ -1204,4 +1190,24 @@ def _make_http_handler(vs: VolumeServer):
                 return
             self._json({"size": size}, code=202)
 
+    # Prometheus request counter + latency per HTTP verb (reference
+    # volume_server_handlers.go stats wrappers). Wrapping the do_*
+    # dispatch — not handle_one_request — so keep-alive idle time
+    # between requests is never measured as request latency.
+    def _instrument(methname):
+        orig = getattr(Handler, methname)
+        verb = methname[3:].lower()
+
+        def wrapped(self):
+            t0 = time.perf_counter()
+            try:
+                orig(self)
+            finally:
+                RequestCounter.labels("volumeServer", verb).inc()
+                RequestHistogram.labels("volumeServer", verb).observe(
+                    time.perf_counter() - t0)
+        return wrapped
+
+    for _m in ("do_GET", "do_HEAD", "do_POST", "do_DELETE"):
+        setattr(Handler, _m, _instrument(_m))
     return Handler

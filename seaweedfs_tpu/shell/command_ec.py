@@ -258,12 +258,26 @@ def ec_balance(env: CommandEnv, argv: List[str], out) -> None:
     p.add_argument("-apply", action="store_true", default=False,
                    help="execute the plan (default: print it only)")
     args = p.parse_args(argv)
+
+    def balance_plan(nodes):
+        """dedupe is applied separately; this is the reference's
+        rack-then-node ordering (command_ec_balance.go:99+): spread
+        each volume's shards across racks first, then even out node
+        loads inside every rack."""
+        across = ec_common.plan_balance_across_racks(nodes)
+        after = ec_common.apply_moves_to_nodes(nodes, across)
+        within = []
+        for rack in sorted({n.rack for n in after}):
+            within += ec_common.plan_balance(
+                [n for n in after if n.rack == rack])
+        return across + within
+
     if not args.apply:
         nodes = env.collect_ec_nodes()
         for vid, sid, url in ec_common.plan_dedupe(nodes):
             out.write(f"would drop duplicate shard {sid} of volume "
                       f"{vid} from {url}\n")
-        for mv in ec_common.plan_balance(nodes):
+        for mv in balance_plan(nodes):
             out.write(f"would move shards {list(mv.shard_ids)} of "
                       f"volume {mv.vid} {mv.src} -> {mv.dst}\n")
         out.write("dry run; add -apply to execute\n")
@@ -284,7 +298,7 @@ def ec_balance(env: CommandEnv, argv: List[str], out) -> None:
             out.write(f"volume {vid}: dropped duplicate shard {sid} "
                       f"from {url}\n")
         nodes = env.collect_ec_nodes()
-        for mv in ec_common.plan_balance(nodes):
+        for mv in balance_plan(nodes):
             apply_shard_move(env, mv, collections.get(mv.vid, ""), out)
     finally:
         env.release_lock()

@@ -22,6 +22,7 @@ class EcNode(NamedTuple):
     url: str
     free_slots: int
     shards: Dict[int, ShardBits]  # vid -> bits held on this node
+    rack: str = ""                # "dc/rack" (ec.balance rack pass)
 
     def shard_count(self) -> int:
         return sum(b.count for b in self.shards.values())
@@ -159,10 +160,11 @@ class CommandEnv:
     ) -> List[EcNode]:
         topo = topo or self.topology()
         nodes = []
-        for _, _, dn in self.data_nodes(topo):
+        for dc, rack, dn in self.data_nodes(topo):
             shards = {e.id: ShardBits(e.ec_index_bits)
                       for e in dn.ec_shard_infos}
-            nodes.append(EcNode(dn.id, int(dn.free_volume_count), shards))
+            nodes.append(EcNode(dn.id, int(dn.free_volume_count), shards,
+                                rack=f"{dc}/{rack}"))
         return nodes
 
     def lookup(self, vid: int, collection: str = "") -> List[str]:

@@ -351,7 +351,7 @@ def fs_meta_notify(env, argv, out):
         if getattr(queue, "failed", 0):
             losses.append(
                 f"{queue.failed} publishes failed "
-                f"(last error: {queue.last_error})")
+                f"(last error: {queue.last_failure})")
         print(f"notified {dirs} directories, {files} files", file=out)
         for loss in losses:
             print(f"WARNING: {loss}", file=out)

@@ -52,20 +52,68 @@ def plan_volume_balance(counts: Dict[str, List[int]],
     return moves
 
 
-def plan_fix_replication(replicas_by_vid: Dict[int, List[Tuple[str, int]]],
-                         all_urls: List[str]) -> List[VolumeMove]:
-    """replicas_by_vid: vid -> [(url, replica_placement_byte)].
-    Returns copies needed to restore the replica count."""
+class NodeLoc(NamedTuple):
+    """Where a node lives, for placement-aware planning."""
+    url: str
+    dc: str = ""
+    rack: str = ""
+
+
+def _placement_deficit(rp: ReplicaPlacement, primary: NodeLoc,
+                       others: List[NodeLoc]):
+    """(dx, dy, dz) still needed with `primary` as the first copy, or
+    None when the existing layout over-fills a dimension."""
+    x = sum(1 for o in others if o.dc != primary.dc)
+    y = sum(1 for o in others
+            if o.dc == primary.dc and o.rack != primary.rack)
+    z = sum(1 for o in others
+            if o.dc == primary.dc and o.rack == primary.rack)
+    dx, dy, dz = rp.diff_dc - x, rp.diff_rack - y, rp.same_rack - z
+    if min(dx, dy, dz) < 0:
+        return None
+    return dx, dy, dz
+
+
+def plan_fix_replication(
+        replicas_by_vid: Dict[int, List[Tuple[NodeLoc, int]]],
+        candidates: List[NodeLoc]) -> List[VolumeMove]:
+    """replicas_by_vid: vid -> [(holder location, placement_byte)].
+    Placement-aware (reference command_volume_fix_replication.go):
+    missing copies go where the xyz grammar wants them — same rack,
+    other racks of the same DC, or other DCs — not just anywhere."""
     fixes = []
-    for vid, replicas in replicas_by_vid.items():
-        want = ReplicaPlacement.from_byte(replicas[0][1]).copy_count
-        have_urls = [u for u, _ in replicas]
-        missing = want - len(have_urls)
-        if missing <= 0:
+    for vid, replicas in sorted(replicas_by_vid.items()):
+        rp = ReplicaPlacement.from_byte(replicas[0][1])
+        holders = [loc for loc, _ in replicas]
+        if len(holders) >= rp.copy_count:
             continue
-        candidates = [u for u in all_urls if u not in have_urls]
-        for dst in candidates[:missing]:
-            fixes.append(VolumeMove(vid, have_urls[0], dst))
+        held_urls = {h.url for h in holders}
+        # the primary whose view leaves the smallest (valid) deficit
+        best = None
+        for primary in holders:
+            d = _placement_deficit(
+                rp, primary, [h for h in holders if h is not primary])
+            if d is not None and (best is None or sum(d) < sum(best[1])):
+                best = (primary, d)
+        if best is None:
+            continue   # existing layout already violates rp; skip
+        primary, (dx, dy, dz) = best
+        free = [c for c in candidates if c.url not in held_urls]
+
+        def take(pred, n):
+            nonlocal free
+            picked = [c for c in free if pred(c)][:n]
+            free = [c for c in free if c not in picked]
+            return picked
+
+        targets = (
+            take(lambda c: c.dc == primary.dc
+                 and c.rack == primary.rack, dz)
+            + take(lambda c: c.dc == primary.dc
+                   and c.rack != primary.rack, dy)
+            + take(lambda c: c.dc != primary.dc, dx))
+        for dst in targets:
+            fixes.append(VolumeMove(vid, primary.url, dst.url))
     return fixes
 
 
@@ -180,14 +228,15 @@ def volume_fix_replication(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()
     try:
         topo = env.topology()
-        replicas: Dict[int, List[Tuple[str, int]]] = {}
-        urls = []
-        for _, _, dn in env.data_nodes(topo):
-            urls.append(dn.id)
+        replicas: Dict[int, List[Tuple[NodeLoc, int]]] = {}
+        locs = []
+        for dc, rack, dn in env.data_nodes(topo):
+            loc = NodeLoc(dn.id, dc, rack)
+            locs.append(loc)
             for vi in dn.volume_infos:
                 replicas.setdefault(vi.id, []).append(
-                    (dn.id, vi.replica_placement))
-        fixes = plan_fix_replication(replicas, urls)
+                    (loc, vi.replica_placement))
+        fixes = plan_fix_replication(replicas, locs)
         for mv in fixes:
             env.volume_server(mv.dst).VolumeCopy(
                 volume_server_pb2.VolumeCopyRequest(

@@ -56,6 +56,75 @@ def plan_dedupe(nodes: List[EcNode]) -> List[Tuple[int, int, str]]:
     return deletes
 
 
+def plan_balance_across_racks(nodes: List[EcNode]) -> List[ShardMove]:
+    """Per EC volume, cap each rack at ceil(shards/racks) shards and
+    move the excess to the least-loaded node of an under-cap rack
+    (reference command_ec_balance.go doBalanceEcShardsAcrossRacks):
+    losing a whole rack must never cost more than a proportional share
+    of one volume's shards."""
+    import math
+    racks = sorted({n.rack for n in nodes})
+    if len(racks) < 2:
+        return []
+    by_url = {n.url: dict(n.shards) for n in nodes}
+    loads = {n.url: n.shard_count() for n in nodes}
+    slots = {n.url: max(n.free_slots, 0) for n in nodes}
+    moves: List[ShardMove] = []
+    vids = sorted({vid for n in nodes for vid in n.shards})
+    for vid in vids:
+        holders = {n.url: by_url[n.url].get(vid, ShardBits(0))
+                   for n in nodes}
+        total = sum(b.count for b in holders.values())
+        if not total:
+            continue
+        cap = math.ceil(total / len(racks))
+        per_rack = {r: sum(holders[n.url].count for n in nodes
+                           if n.rack == r) for r in racks}
+        for rack in racks:
+            while per_rack[rack] > cap:
+                # the busiest holder in the over-cap rack gives a shard
+                src = max((n for n in nodes if n.rack == rack
+                           and holders[n.url].count),
+                          key=lambda n: holders[n.url].count)
+                sid = holders[src.url].shard_ids[0]
+                under = [n for n in nodes
+                         if per_rack[n.rack] < cap
+                         and slots[n.url] > 0
+                         and not holders[n.url].has(sid)]
+                if not under:
+                    break
+                dst = min(under, key=lambda n: loads[n.url])
+                slots[dst.url] -= 1
+                slots[src.url] += 1
+                moves.append(ShardMove(vid, (sid,), src.url, dst.url))
+                holders[src.url] = holders[src.url].remove(sid)
+                holders[dst.url] = holders[dst.url].add(sid)
+                by_url[src.url][vid] = holders[src.url]
+                by_url[dst.url][vid] = holders[dst.url]
+                loads[src.url] -= 1
+                loads[dst.url] += 1
+                per_rack[rack] -= 1
+                per_rack[dst.rack] += 1
+    return moves
+
+
+def apply_moves_to_nodes(nodes: List[EcNode],
+                         moves: List[ShardMove]) -> List[EcNode]:
+    """The node view after a plan executes — lets the within-rack pass
+    plan on top of the across-racks pass without a topology refetch."""
+    by_url = {n.url: dict(n.shards) for n in nodes}
+    for mv in moves:
+        for sid in mv.shard_ids:
+            src = by_url[mv.src].get(mv.vid, ShardBits(0)).remove(sid)
+            if src.count:
+                by_url[mv.src][mv.vid] = src
+            else:
+                by_url[mv.src].pop(mv.vid, None)
+            by_url[mv.dst][mv.vid] = \
+                by_url[mv.dst].get(mv.vid, ShardBits(0)).add(sid)
+    return [n._replace(shards=by_url[n.url]) for n in nodes]
+
+
 def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
     """Even out total shard counts across nodes (reference
     ec.balance's doBalanceEcShardsAcrossRacks simplified to node
@@ -65,14 +134,15 @@ def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
     counts = {n.url: n.shard_count() for n in nodes}
     by_url = {n.url: dict(n.shards) for n in nodes}
     total = sum(counts.values())
-    avg = total / len(nodes)
     moves: List[ShardMove] = []
-    # move shards one at a time from the fullest node to the emptiest
+    # move shards one at a time from the fullest node to the emptiest;
+    # a spread of <= 1 is balanced (moving would just ping-pong a
+    # shard back and forth — regression: odd totals over two nodes
+    # oscillated until the loop bound)
     for _ in range(total):
         src = max(counts, key=lambda u: counts[u])
         dst = min(counts, key=lambda u: counts[u])
-        if counts[src] - 1 < avg - 0.5 or counts[dst] + 1 > avg + 0.5 \
-                or src == dst:
+        if src == dst or counts[src] - counts[dst] <= 1:
             break
         moved = False
         for vid, bits in sorted(by_url[src].items()):

@@ -162,14 +162,13 @@ class Store:
             volumes = []
             ec_shards = []
             sizes: dict = {}
+            counts: dict = {}
             for loc in self.locations:
                 for v in loc.volumes.values():
                     volumes.append(self.volume_info(v))
                     sizes[v.collection] = sizes.get(v.collection, 0) + \
                         v.content_size
-            counts: dict = {}
-            for vi in volumes:
-                counts[vi["collection"]] = counts.get(vi["collection"],
+                    counts[v.collection] = counts.get(v.collection,
                                                       0) + 1
             # zero collections that disappeared since the last pass, or
             # dashboards keep showing a deleted collection's last value

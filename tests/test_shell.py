@@ -83,10 +83,62 @@ def test_plan_volume_balance():
 
 
 def test_plan_fix_replication():
+    from seaweedfs_tpu.shell.command_volume import NodeLoc
+    a = NodeLoc("a:1", "dc1", "r1")
+    b = NodeLoc("b:1", "dc1", "r1")
     # vid 5 wants 2 copies (placement 001 -> byte 1) but has 1
-    replicas = {5: [("a:1", 1)], 6: [("a:1", 0)]}
-    fixes = plan_fix_replication(replicas, ["a:1", "b:1"])
+    replicas = {5: [(a, 1)], 6: [(a, 0)]}
+    fixes = plan_fix_replication(replicas, [a, b])
     assert fixes == [(5, "a:1", "b:1")]
+
+
+def test_plan_fix_replication_honors_placement():
+    """Placement 110 = one copy in another DC + one in another rack of
+    the same DC; the planner must pick those, not same-rack peers."""
+    from seaweedfs_tpu.shell.command_volume import NodeLoc
+    a = NodeLoc("a:1", "dc1", "r1")
+    same_rack = NodeLoc("b:1", "dc1", "r1")
+    other_rack = NodeLoc("c:1", "dc1", "r2")
+    other_dc = NodeLoc("d:1", "dc2", "r1")
+    fixes = plan_fix_replication(
+        {9: [(a, 110)]}, [a, same_rack, other_rack, other_dc])
+    dsts = {mv.dst for mv in fixes}
+    assert dsts == {"c:1", "d:1"}       # NOT the same-rack b:1
+
+
+def test_plan_fix_replication_partial_progress():
+    """001 needs a same-rack peer; with none available nothing is
+    planned rather than violating the grammar."""
+    from seaweedfs_tpu.shell.command_volume import NodeLoc
+    a = NodeLoc("a:1", "dc1", "r1")
+    other_rack = NodeLoc("c:1", "dc1", "r2")
+    fixes = plan_fix_replication({9: [(a, 1)]}, [a, other_rack])
+    assert fixes == []
+
+
+def test_plan_balance_across_racks():
+    """One volume's 14 shards piled into one rack must spread so no
+    rack holds more than ceil(14/racks)."""
+    from seaweedfs_tpu.shell import ec_common
+    nodes = [
+        EcNode("a:1", 20, {1: ShardBits.of(*range(10))}, rack="dc/r1"),
+        EcNode("b:1", 20, {1: ShardBits.of(10, 11, 12, 13)},
+               rack="dc/r1"),
+        EcNode("c:1", 20, {}, rack="dc/r2"),
+        EcNode("d:1", 20, {}, rack="dc/r3"),
+    ]
+    moves = ec_common.plan_balance_across_racks(nodes)
+    after = ec_common.apply_moves_to_nodes(nodes, moves)
+    per_rack = {}
+    held = {}
+    for n in after:
+        bits = n.shards.get(1, ShardBits(0))
+        per_rack[n.rack] = per_rack.get(n.rack, 0) + bits.count
+        for sid in bits.shard_ids:
+            assert sid not in held, f"shard {sid} duplicated"
+            held[sid] = n.url
+    assert len(held) == 14              # nothing lost
+    assert max(per_rack.values()) <= 5  # ceil(14/3)
 
 
 # -- live cluster --------------------------------------------------------------
@@ -430,3 +482,37 @@ def test_volume_move_preserves_readonly(cluster, shell):
     dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
     assert dst_vs.store.find_volume(vid).read_only
     assert operations.download(cluster.master.url, fid) == b"sealed blob"
+
+
+def test_plan_balance_no_pingpong_on_odd_totals():
+    """3-vs-2 shards across two nodes is balanced; the planner must
+    not oscillate a shard between them (regression: the live
+    ec.balance executed 5 wasteful back-and-forth moves)."""
+    nodes = [
+        EcNode("a:1", 5, {1: ShardBits.of(0, 1, 2)}),
+        EcNode("b:1", 5, {1: ShardBits.of(3, 4)}),
+    ]
+    assert ec_common.plan_balance(nodes) == []
+    # a genuine imbalance still planned, and it converges
+    nodes = [
+        EcNode("a:1", 5, {1: ShardBits.of(0, 1, 2, 3)}),
+        EcNode("b:1", 5, {}),
+    ]
+    moves = ec_common.plan_balance(nodes)
+    assert len(moves) == 2
+    assert all(mv.src == "a:1" and mv.dst == "b:1" for mv in moves)
+
+
+def test_plan_balance_across_racks_respects_free_slots():
+    """The only under-cap rack has a full node: the planner must not
+    overfill it (regression: free_slots were ignored)."""
+    nodes = [
+        EcNode("a:1", 20, {1: ShardBits.of(*range(14))}, rack="dc/r1"),
+        EcNode("b:1", 0, {}, rack="dc/r2"),     # full disk
+        EcNode("c:1", 3, {}, rack="dc/r3"),
+    ]
+    moves = ec_common.plan_balance_across_racks(nodes)
+    to_b = sum(len(mv.shard_ids) for mv in moves if mv.dst == "b:1")
+    to_c = sum(len(mv.shard_ids) for mv in moves if mv.dst == "c:1")
+    assert to_b == 0
+    assert 0 < to_c <= 3
