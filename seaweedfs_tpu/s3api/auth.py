@@ -146,6 +146,31 @@ class Iam:
                                    auth), payload
         raise S3AuthError("AccessDenied", "no credentials provided")
 
+    def verify_post_policy(self, fields: Dict[str, str]) -> Identity:
+        """Authenticate a POST-policy form upload: the SigV4 signature
+        is over the RAW base64 policy string with the credential's
+        date/region-scoped key (reference
+        s3api/auth_signature_v4.go DoesPolicySignatureMatch)."""
+        policy = fields.get("policy", "")
+        if not policy:
+            raise S3AuthError("AccessDenied", "form has no policy")
+        if fields.get("x-amz-algorithm") != "AWS4-HMAC-SHA256":
+            raise S3AuthError("AccessDenied", "unsupported algorithm")
+        cred = fields.get("x-amz-credential", "")
+        parts = cred.split("/")
+        if len(parts) != 5 or parts[4] != "aws4_request":
+            raise S3AuthError("AccessDenied",
+                              f"malformed credential {cred!r}")
+        access, date, region, service, _ = parts
+        ident, c = self.lookup(access)
+        key = self._signing_key(c.secret_key, date, region, service)
+        want = hmac.new(key, policy.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want,
+                                   fields.get("x-amz-signature", "")):
+            raise S3AuthError("SignatureDoesNotMatch",
+                              "policy signature mismatch")
+        return ident
+
     # -- SigV4 ----------------------------------------------------------------
 
     @staticmethod

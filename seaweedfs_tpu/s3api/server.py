@@ -256,6 +256,14 @@ def _make_handler(s3: S3ApiServer):
             bucket, key, qs, raw_q = self._parse()
             raw = self._body() if self.command in ("PUT", "POST") else b""
             try:
+                if (self.command == "POST" and bucket and not key
+                        and "multipart/form-data" in
+                        self.headers.get("Content-Type", "")):
+                    # browser form upload: the signed policy inside the
+                    # form IS the authentication (reference routes
+                    # bucket POST to PostPolicyBucketHandler before the
+                    # auth middleware)
+                    return self._post_policy_upload(bucket, raw)
                 headers = {k.lower(): v for k, v in self.headers.items()}
                 u = urllib.parse.urlparse(self.path)
                 self._ident, payload = s3.iam.authenticate_and_decode(
@@ -322,6 +330,68 @@ def _make_handler(s3: S3ApiServer):
                 self._list_objects(bucket, qs)
             else:
                 self._error("MethodNotAllowed", self.command, 405)
+
+        def _post_policy_upload(self, bucket: str, payload: bytes):
+            """Browser form upload (reference
+            s3api_object_handlers_postpolicy.go PostPolicyBucketHandler):
+            verify the signed policy, enforce its conditions, store the
+            file at the form's key."""
+            from seaweedfs_tpu.s3api.post_policy import (PolicyError,
+                                                         check_policy,
+                                                         parse_form)
+            try:
+                fields, data, filename = parse_form(
+                    self.headers.get("Content-Type", ""), payload)
+            except PolicyError as e:
+                return self._error(e.code, str(e), e.status)
+            if data is None:
+                return self._error("MalformedPOSTRequest",
+                                   "form has no file part", 400)
+            key = fields.get("key", "")
+            if not key:
+                return self._error("MalformedPOSTRequest",
+                                   "form has no key", 400)
+            key = key.replace("${filename}", filename)
+            values = dict(fields)
+            values["bucket"] = bucket
+            values["key"] = key
+            try:
+                if s3.iam.is_enabled:
+                    ident = s3.iam.verify_post_policy(fields)
+                    if not ident.can_do(ACTION_WRITE, bucket):
+                        raise S3AuthError("AccessDenied",
+                                          "not allowed to write")
+                if fields.get("policy"):
+                    check_policy(fields["policy"], values, len(data))
+            except PolicyError as e:
+                return self._error(e.code, str(e), e.status)
+            except S3AuthError as e:
+                return self._error(e.code, str(e), e.status)
+            if s3.find_entry(BUCKETS_DIR, bucket) is None:
+                return self._error("NoSuchBucket", bucket, 404)
+            mime = fields.get("content-type", "")
+            _, resp_headers = s3.filer_put(
+                f"{BUCKETS_DIR}/{bucket}/{key}", data, mime=mime)
+            etag = resp_headers.get("ETag", "").strip('"') or \
+                hashlib.md5(data).hexdigest()
+            redirect = fields.get("success_action_redirect")
+            if redirect:
+                sep = "&" if "?" in redirect else "?"
+                return self._reply(303, headers={
+                    "Location": f"{redirect}{sep}bucket={bucket}"
+                                f"&key={urllib.parse.quote(key)}"
+                                f"&etag=%22{etag}%22"})
+            status = fields.get("success_action_status", "204")
+            if status == "201":
+                loc = f"http://{s3.url}/{bucket}/{urllib.parse.quote(key)}"
+                root = _xml("PostResponse",
+                            _xml("Location", text=loc),
+                            _xml("Bucket", text=bucket),
+                            _xml("Key", text=key),
+                            _xml("ETag", text=f'"{etag}"'))
+                return self._reply(201, _render(root))
+            self._reply(200 if status == "200" else 204,
+                        headers={"ETag": f'"{etag}"'})
 
         # -- object -----------------------------------------------------------
 

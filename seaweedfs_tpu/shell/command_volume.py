@@ -88,13 +88,14 @@ def plan_fix_replication(
         if len(holders) >= rp.copy_count:
             continue
         held_urls = {h.url for h in holders}
-        # the primary whose view leaves the smallest (valid) deficit
-        best = None
-        for primary in holders:
-            d = _placement_deficit(
-                rp, primary, [h for h in holders if h is not primary])
-            if d is not None and (best is None or sum(d) < sum(best[1])):
-                best = (primary, d)
+        # any primary with a non-negative deficit works (every valid
+        # primary's deficit sums to copy_count - len(holders))
+        best = next(
+            ((p, d) for p in holders
+             if (d := _placement_deficit(
+                 rp, p, [h for h in holders if h is not p]))
+             is not None),
+            None)
         if best is None:
             continue   # existing layout already violates rp; skip
         primary, (dx, dy, dz) = best

@@ -82,37 +82,50 @@ def plan_balance_across_racks(nodes: List[EcNode]) -> List[ShardMove]:
                            if n.rack == r) for r in racks}
         for rack in racks:
             while per_rack[rack] > cap:
-                # the busiest holder in the over-cap rack gives a shard
-                src = max((n for n in nodes if n.rack == rack
-                           and holders[n.url].count),
-                          key=lambda n: holders[n.url].count)
-                sid = holders[src.url].shard_ids[0]
-                under = [n for n in nodes
-                         if per_rack[n.rack] < cap
-                         and slots[n.url] > 0
-                         and not holders[n.url].has(sid)]
-                if not under:
+                # busiest holders first, and EVERY shard they hold is a
+                # candidate — a single duplicated sid must not strand
+                # the whole rack over cap
+                placed = False
+                for src in sorted(
+                        (n for n in nodes if n.rack == rack
+                         and holders[n.url].count),
+                        key=lambda n: -holders[n.url].count):
+                    for sid in holders[src.url].shard_ids:
+                        under = [n for n in nodes
+                                 if per_rack[n.rack] < cap
+                                 and slots[n.url] > 0
+                                 and not holders[n.url].has(sid)]
+                        if not under:
+                            continue
+                        dst = min(under, key=lambda n: loads[n.url])
+                        slots[dst.url] -= 1
+                        slots[src.url] += 1
+                        moves.append(ShardMove(vid, (sid,), src.url,
+                                               dst.url))
+                        holders[src.url] = holders[src.url].remove(sid)
+                        holders[dst.url] = holders[dst.url].add(sid)
+                        by_url[src.url][vid] = holders[src.url]
+                        by_url[dst.url][vid] = holders[dst.url]
+                        loads[src.url] -= 1
+                        loads[dst.url] += 1
+                        per_rack[rack] -= 1
+                        per_rack[dst.rack] += 1
+                        placed = True
+                        break
+                    if placed:
+                        break
+                if not placed:
                     break
-                dst = min(under, key=lambda n: loads[n.url])
-                slots[dst.url] -= 1
-                slots[src.url] += 1
-                moves.append(ShardMove(vid, (sid,), src.url, dst.url))
-                holders[src.url] = holders[src.url].remove(sid)
-                holders[dst.url] = holders[dst.url].add(sid)
-                by_url[src.url][vid] = holders[src.url]
-                by_url[dst.url][vid] = holders[dst.url]
-                loads[src.url] -= 1
-                loads[dst.url] += 1
-                per_rack[rack] -= 1
-                per_rack[dst.rack] += 1
     return moves
 
 
 def apply_moves_to_nodes(nodes: List[EcNode],
                          moves: List[ShardMove]) -> List[EcNode]:
-    """The node view after a plan executes — lets the within-rack pass
-    plan on top of the across-racks pass without a topology refetch."""
+    """The node view after a plan executes (shards AND free slots) —
+    lets the within-rack pass plan on top of the across-racks pass
+    without a topology refetch."""
     by_url = {n.url: dict(n.shards) for n in nodes}
+    slots = {n.url: n.free_slots for n in nodes}
     for mv in moves:
         for sid in mv.shard_ids:
             src = by_url[mv.src].get(mv.vid, ShardBits(0)).remove(sid)
@@ -122,7 +135,10 @@ def apply_moves_to_nodes(nodes: List[EcNode],
                 by_url[mv.src].pop(mv.vid, None)
             by_url[mv.dst][mv.vid] = \
                 by_url[mv.dst].get(mv.vid, ShardBits(0)).add(sid)
-    return [n._replace(shards=by_url[n.url]) for n in nodes]
+            slots[mv.src] += 1
+            slots[mv.dst] -= 1
+    return [n._replace(shards=by_url[n.url],
+                       free_slots=slots[n.url]) for n in nodes]
 
 
 def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
@@ -133,16 +149,20 @@ def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
         return []
     counts = {n.url: n.shard_count() for n in nodes}
     by_url = {n.url: dict(n.shards) for n in nodes}
+    slots = {n.url: max(n.free_slots, 0) for n in nodes}
     total = sum(counts.values())
     moves: List[ShardMove] = []
-    # move shards one at a time from the fullest node to the emptiest;
-    # a spread of <= 1 is balanced (moving would just ping-pong a
-    # shard back and forth — regression: odd totals over two nodes
-    # oscillated until the loop bound)
+    # move shards one at a time from the fullest node to the emptiest
+    # node with free capacity; a spread of <= 1 is balanced (moving
+    # would just ping-pong a shard back and forth — regression: odd
+    # totals over two nodes oscillated until the loop bound)
     for _ in range(total):
         src = max(counts, key=lambda u: counts[u])
-        dst = min(counts, key=lambda u: counts[u])
-        if src == dst or counts[src] - counts[dst] <= 1:
+        with_room = [u for u in counts if slots[u] > 0 and u != src]
+        if not with_room:
+            break
+        dst = min(with_room, key=lambda u: counts[u])
+        if counts[src] - counts[dst] <= 1:
             break
         moved = False
         for vid, bits in sorted(by_url[src].items()):
@@ -157,6 +177,8 @@ def plan_balance(nodes: List[EcNode]) -> List[ShardMove]:
                 by_url[dst][vid] = dst_bits.add(sid)
                 counts[src] -= 1
                 counts[dst] += 1
+                slots[src] += 1
+                slots[dst] -= 1
                 moved = True
                 break
             if moved:

@@ -1,0 +1,251 @@
+"""S3 POST-policy (browser form) uploads end-to-end."""
+
+import base64
+import datetime
+import hashlib
+import hmac
+import json
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from seaweedfs_tpu.s3api import Credential, Iam, Identity, S3ApiServer
+from seaweedfs_tpu.s3api.auth import ACTION_WRITE
+from tests.cluster_util import Cluster, free_port_pair
+from tests.test_s3 import ACCESS, SECRET, SigV4Client
+
+REGION = "us-east-1"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("postpolicy"),
+                n_volume_servers=1, with_filer=True)
+    iam = Iam([
+        Identity(name="writer",
+                 credentials=[Credential(ACCESS, SECRET)],
+                 actions=[ACTION_WRITE, "Admin"]),
+    ])
+    c.s3 = S3ApiServer(filer_url=c.filer.url, port=free_port_pair(),
+                       iam=iam)
+    c.s3.start()
+    with SigV4Client(c.s3.url).request("PUT", "/formbkt"):
+        pass
+    yield c
+    c.s3.stop()
+    c.stop()
+
+
+def _sign_policy(policy_b64: str, date: str,
+                 secret: str = SECRET) -> str:
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+    k = h(("AWS4" + secret).encode(), date)
+    k = h(h(h(k, REGION), "s3"), "aws4_request")
+    return hmac.new(k, policy_b64.encode(), hashlib.sha256).hexdigest()
+
+
+def _form(fields: dict, file_data: bytes,
+          filename: str = "up.bin") -> tuple:
+    boundary = "form-boundary-123"
+    out = b""
+    for k, v in fields.items():
+        out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="{k}"\r\n\r\n{v}\r\n').encode()
+    out += (f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="{filename}"\r\n'
+            f"Content-Type: application/octet-stream\r\n\r\n").encode()
+    out += file_data + f"\r\n--{boundary}--\r\n".encode()
+    return out, f"multipart/form-data; boundary={boundary}"
+
+
+def _policy_fields(key: str, conditions=None, expires_in=600,
+                   extra_conditions=()):
+    exp = datetime.datetime.now(datetime.timezone.utc) + \
+        datetime.timedelta(seconds=expires_in)
+    date = time.strftime("%Y%m%d", time.gmtime())
+    cred = f"{ACCESS}/{date}/{REGION}/s3/aws4_request"
+    doc = {"expiration": exp.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+           "conditions": (conditions if conditions is not None else [
+               {"bucket": "formbkt"},
+               ["starts-with", "$key", ""],
+               {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+               {"x-amz-credential": cred},
+           ] + list(extra_conditions))}
+    policy = base64.b64encode(json.dumps(doc).encode()).decode()
+    return {
+        "key": key,
+        "policy": policy,
+        "x-amz-algorithm": "AWS4-HMAC-SHA256",
+        "x-amz-credential": cred,
+        "x-amz-signature": _sign_policy(policy, date),
+    }
+
+
+def _post(cluster, body, ctype):
+    req = urllib.request.Request(
+        f"http://{cluster.s3.url}/formbkt", data=body,
+        method="POST", headers={"Content-Type": ctype})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_form_upload_roundtrip(cluster):
+    fields = _policy_fields("docs/${filename}")
+    body, ctype = _form(fields, b"browser upload bytes",
+                        filename="report.pdf")
+    with _post(cluster, body, ctype) as r:
+        assert r.status == 204
+    # ${filename} substituted; object readable through the normal API
+    with SigV4Client(cluster.s3.url).request(
+            "GET", "/formbkt/docs/report.pdf") as r:
+        assert r.read() == b"browser upload bytes"
+
+
+def test_success_action_status_201_returns_xml(cluster):
+    fields = _policy_fields(
+        "x201.bin",
+        extra_conditions=[{"success_action_status": "201"}])
+    fields["success_action_status"] = "201"
+    body, ctype = _form(fields, b"x" * 64)
+    with _post(cluster, body, ctype) as r:
+        assert r.status == 201
+        doc = ET.fromstring(r.read())
+        texts = {el.tag.split("}")[-1]: el.text for el in doc.iter()}
+        assert texts["Key"] == "x201.bin"
+        assert texts["Bucket"] == "formbkt"
+
+
+def test_redirect_on_success(cluster):
+    fields = _policy_fields(
+        "redir.bin",
+        extra_conditions=[["starts-with", "$success_action_redirect",
+                           "http://127.0.0.1:1/"]])
+    fields["success_action_redirect"] = "http://127.0.0.1:1/done"
+    body, ctype = _form(fields, b"y" * 16)
+    req = urllib.request.Request(
+        f"http://{cluster.s3.url}/formbkt", data=body, method="POST",
+        headers={"Content-Type": ctype})
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **kw):
+            return None
+    opener = urllib.request.build_opener(NoRedirect)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        opener.open(req, timeout=30)
+    assert ei.value.code == 303
+    assert ei.value.headers["Location"].startswith(
+        "http://127.0.0.1:1/done?bucket=formbkt&key=redir.bin")
+
+
+def test_bad_signature_rejected(cluster):
+    fields = _policy_fields("evil.bin")
+    fields["x-amz-signature"] = "0" * 64
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 403
+    assert b"SignatureDoesNotMatch" in ei.value.read()
+
+
+def test_expired_policy_rejected(cluster):
+    fields = _policy_fields("late.bin", expires_in=-60)
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 403
+    assert b"expired" in ei.value.read()
+
+
+def test_starts_with_condition_enforced(cluster):
+    date = time.strftime("%Y%m%d", time.gmtime())
+    cred = f"{ACCESS}/{date}/{REGION}/s3/aws4_request"
+    fields = _policy_fields(
+        "outside/secret.bin",
+        conditions=[["starts-with", "$key", "uploads/"],
+                    {"x-amz-credential": cred},
+                    {"x-amz-algorithm": "AWS4-HMAC-SHA256"}])
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 403
+
+
+def test_content_length_range_enforced(cluster):
+    fields = _policy_fields(
+        "big.bin", extra_conditions=[["content-length-range", 1, 10]])
+    body, ctype = _form(fields, b"q" * 100)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 400
+    assert b"EntityTooLarge" in ei.value.read()
+
+
+def test_tampered_policy_rejected(cluster):
+    """Changing the policy after signing must invalidate the upload —
+    the signature covers the exact base64 string."""
+    fields = _policy_fields("tamper.bin")
+    doc = json.loads(base64.b64decode(fields["policy"]))
+    doc["conditions"] = []
+    fields["policy"] = base64.b64encode(
+        json.dumps(doc).encode()).decode()
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 403
+
+
+def test_uncovered_form_field_rejected(cluster):
+    """Default-deny: a form field the signed policy never mentions must
+    fail, or the signer's policy would not constrain the upload."""
+    fields = _policy_fields("sneaky.bin")
+    fields["success_action_redirect"] = "http://evil.example/"
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 403
+    assert b"not covered" in ei.value.read()
+
+
+def test_naive_expiration_is_malformed_not_crash(cluster):
+    """A timezone-naive expiration must yield clean 400/403, not an
+    aware-vs-naive TypeError (regression)."""
+    import base64 as b64
+    import json as j
+    fields = _policy_fields("naive.bin")
+    doc = j.loads(b64.b64decode(fields["policy"]))
+    doc["expiration"] = "2999-01-01T00:00:00"      # no Z / offset
+    fields["policy"] = b64.b64encode(j.dumps(doc).encode()).decode()
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    # signature no longer matches the edited policy -> 403, never a
+    # dropped connection
+    assert ei.value.code == 403
+
+
+def test_malformed_range_is_400(cluster):
+    import base64 as b64
+    import datetime as dt
+    import json as j
+    exp = dt.datetime.now(dt.timezone.utc) + dt.timedelta(minutes=5)
+    date = time.strftime("%Y%m%d", time.gmtime())
+    cred = f"{ACCESS}/{date}/{REGION}/s3/aws4_request"
+    doc = {"expiration": exp.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+           "conditions": [{"bucket": "formbkt"},
+                          ["starts-with", "$key", ""],
+                          {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+                          {"x-amz-credential": cred},
+                          ["content-length-range", "a", "b"]]}
+    policy = b64.b64encode(j.dumps(doc).encode()).decode()
+    fields = {"key": "m.bin", "policy": policy,
+              "x-amz-algorithm": "AWS4-HMAC-SHA256",
+              "x-amz-credential": cred,
+              "x-amz-signature": _sign_policy(policy, date)}
+    body, ctype = _form(fields, b"z")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(cluster, body, ctype)
+    assert ei.value.code == 400
+    assert b"MalformedPOSTRequest" in ei.value.read()

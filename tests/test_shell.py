@@ -516,3 +516,26 @@ def test_plan_balance_across_racks_respects_free_slots():
     to_c = sum(len(mv.shard_ids) for mv in moves if mv.dst == "c:1")
     assert to_b == 0
     assert 0 < to_c <= 3
+
+
+def test_plan_balance_respects_free_slots():
+    """The within-rack pass must not plan moves onto full nodes."""
+    nodes = [
+        EcNode("a:1", 5, {1: ShardBits.of(*range(10))}),
+        EcNode("b:1", 0, {}),   # full disk
+    ]
+    assert ec_common.plan_balance(nodes) == []
+
+
+def test_plan_balance_across_racks_duplicated_first_shard():
+    """A duplicated first shard id must not strand the rack: the
+    planner has to fall back to the holder's other shards."""
+    nodes = [
+        EcNode("a:1", 20, {1: ShardBits.of(0, 1, 2, 3)}, rack="dc/r1"),
+        # both under-cap nodes already hold shard 0 (pre-dedupe view)
+        EcNode("b:1", 20, {1: ShardBits.of(0)}, rack="dc/r2"),
+        EcNode("c:1", 20, {1: ShardBits.of(0)}, rack="dc/r3"),
+    ]
+    moves = ec_common.plan_balance_across_racks(nodes)
+    moved = {sid for mv in moves for sid in mv.shard_ids}
+    assert moved and 0 not in moved       # fell back past shard 0
