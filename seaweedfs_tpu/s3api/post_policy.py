@@ -31,35 +31,19 @@ def parse_form(content_type: str, body: bytes
                ) -> Tuple[Dict[str, str], Optional[bytes], str]:
     """multipart/form-data -> (fields lower-cased, file bytes, filename).
     Everything after the `file` part is ignored, like S3 ("fields after
-    the file are not processed")."""
-    if "boundary=" not in (content_type or ""):
-        raise PolicyError("MalformedPOSTRequest",
-                          "not multipart/form-data", 400)
-    boundary = content_type.split("boundary=", 1)[1].split(";")[0].strip()
-    delim = ("--" + boundary).encode()
+    the file are not processed"). Parsing rides the shared
+    util.multipart.iter_parts."""
+    from seaweedfs_tpu.util.multipart import iter_parts
     fields: Dict[str, str] = {}
-    for part in body.split(delim)[1:]:
-        if part.startswith(b"--"):
-            break
-        part = part.lstrip(b"\r\n")
-        header_blob, sep, data = part.partition(b"\r\n\r\n")
-        if not sep:
-            continue
-        data = data[:-2] if data.endswith(b"\r\n") else data
-        name = filename = ""
-        for line in header_blob.split(b"\r\n"):
-            text = line.decode("utf-8", "replace")
-            if text.lower().startswith("content-disposition:"):
-                for item in text.split(";")[1:]:
-                    item = item.strip()
-                    if item.startswith("name="):
-                        name = item[5:].strip('"')
-                    elif item.startswith("filename="):
-                        filename = item[9:].strip('"')
-        if name == "file":
-            return fields, data, filename
-        if name:
-            fields[name.lower()] = data.decode("utf-8", "replace")
+    try:
+        for name, filename, _headers, data in iter_parts(content_type,
+                                                         body):
+            if name == "file":
+                return fields, data, filename
+            if name:
+                fields[name.lower()] = data.decode("utf-8", "replace")
+    except ValueError as e:
+        raise PolicyError("MalformedPOSTRequest", str(e), 400) from None
     return fields, None, ""
 
 

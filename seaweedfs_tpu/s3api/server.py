@@ -403,6 +403,8 @@ def _make_handler(s3: S3ApiServer):
                 return self._initiate_multipart(bucket, key)
             if self.command == "PUT" and "uploadId" in qs:
                 self._auth(ACTION_WRITE, bucket, payload)
+                if self.headers.get("x-amz-copy-source"):
+                    return self._copy_object_part(bucket, key, qs)
                 return self._upload_part(bucket, key, qs, payload)
             if self.command == "POST" and "uploadId" in qs:
                 self._auth(ACTION_WRITE, bucket, payload)
@@ -579,9 +581,20 @@ def _make_handler(s3: S3ApiServer):
                 _xml("Key", text=key),
                 _xml("UploadId", text=upload_id))))
 
+        @staticmethod
+        def _part_number(qs):
+            """partNumber as int, or None when non-numeric/absent."""
+            try:
+                return int(qs.get("partNumber", [""])[0])
+            except (ValueError, IndexError):
+                return None
+
         def _upload_part(self, bucket: str, key: str, qs, payload: bytes):
             upload_id = qs.get("uploadId", [""])[0]
-            part = int(qs.get("partNumber", ["0"])[0])
+            part = self._part_number(qs)
+            if part is None:
+                return self._error("InvalidArgument",
+                                   "bad partNumber", 400)
             updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
             if s3.find_entry(
                     f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
@@ -590,6 +603,42 @@ def _make_handler(s3: S3ApiServer):
             s3.filer_put(f"{updir}/{part:04d}.part", payload)
             self._reply(200, headers={
                 "ETag": f'"{hashlib.md5(payload).hexdigest()}"'})
+
+        def _copy_object_part(self, bucket: str, key: str, qs):
+            """UploadPartCopy (reference
+            s3api_object_copy_handlers.go CopyObjectPartHandler): a
+            part sourced from an existing object, optionally a byte
+            range via x-amz-copy-source-range."""
+            upload_id = qs.get("uploadId", [""])[0]
+            part = self._part_number(qs)
+            if part is None:
+                return self._error("InvalidArgument",
+                                   "bad partNumber", 400)
+            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
+            if s3.find_entry(
+                    f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
+                    upload_id) is None:
+                return self._error("NoSuchUpload", upload_id, 404)
+            src = urllib.parse.unquote(
+                self.headers["x-amz-copy-source"]).lstrip("/")
+            sbucket, _, skey = src.partition("/")
+            if s3.find_entry(_dir_of(sbucket, skey),
+                             _name_of(skey)) is None:
+                return self._error("NoSuchKey", src, 404)
+            rng = self.headers.get("x-amz-copy-source-range")
+            try:
+                _, data, _ = s3.filer_get(
+                    f"{BUCKETS_DIR}/{sbucket}/{skey}", rng)
+            except urllib.error.HTTPError as e:
+                if e.code == 416:
+                    return self._error("InvalidRange", rng or "", 416)
+                return self._error("NoSuchKey", src, e.code)
+            s3.filer_put(f"{updir}/{part:04d}.part", data)
+            self._reply(200, _render(_xml(
+                "CopyPartResult",
+                _xml("ETag",
+                     text=f'"{hashlib.md5(data).hexdigest()}"'),
+                _xml("LastModified", text=_iso(int(time.time()))))))
 
         @staticmethod
         def _manifest_part_numbers(payload: bytes) -> Optional[set]:

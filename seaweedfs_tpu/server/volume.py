@@ -874,40 +874,12 @@ class VolumeServer:
 
 
 def parse_multipart(content_type: str, body: bytes):
-    """Minimal multipart/form-data parser: returns (filename, mime, data,
-    encoding) of the first file part, where encoding is the part's
-    Content-Encoding (reference needle_parse_upload.go)."""
-    boundary = None
-    for piece in content_type.split(";"):
-        piece = piece.strip()
-        if piece.startswith("boundary="):
-            boundary = piece[len("boundary="):].strip('"')
-    if not boundary:
-        raise ValueError("multipart without boundary")
-    delim = b"--" + boundary.encode()
+    """Returns (filename, mime, data, encoding) of the first file part,
+    where encoding is the part's Content-Encoding (reference
+    needle_parse_upload.go). Parsing rides util.multipart.iter_parts."""
+    from seaweedfs_tpu.util.multipart import iter_parts
     fallback = None
-    segments = body.split(delim)
-    for part in segments[1:]:
-        if part.startswith(b"--"):
-            break  # closing delimiter
-        # strip ONLY the framing CRLFs (after the delimiter line and
-        # before the next one) — trailing newlines inside the file
-        # content must survive
-        if part.startswith(b"\r\n"):
-            part = part[2:]
-        if part.endswith(b"\r\n"):
-            part = part[:-2]
-        header_blob, _, data = part.partition(b"\r\n\r\n")
-        headers = {}
-        for line in header_blob.split(b"\r\n"):
-            k, _, v = line.decode("utf-8", "replace").partition(":")
-            headers[k.strip().lower()] = v.strip()
-        disp = headers.get("content-disposition", "")
-        filename = ""
-        for item in disp.split(";"):
-            item = item.strip()
-            if item.startswith("filename="):
-                filename = item[len("filename="):].strip('"')
+    for _name, filename, headers, data in iter_parts(content_type, body):
         mime = headers.get("content-type", "")
         encoding = headers.get("content-encoding", "")
         if filename:

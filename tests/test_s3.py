@@ -535,3 +535,72 @@ def test_manifest_chunked_object_through_s3(tmp_path):
     finally:
         s3srv.stop()
         c.stop()
+
+
+class TestUploadPartCopy:
+    def test_copy_object_part_assembles(self, cluster, s3c):
+        # source object of 3 known segments
+        src_data = b"A" * 1000 + b"B" * 1000 + b"C" * 1000
+        with s3c.request("PUT", "/tbkt/partsrc.bin", data=src_data):
+            pass
+        with s3c.request("POST", "/tbkt/copied.bin", "uploads") as r:
+            upload_id = [e.text for e in ET.fromstring(r.read()).iter()
+                         if e.tag.endswith("UploadId")][0]
+        # part 1: middle range via UploadPartCopy; part 2: plain bytes
+        with s3c.request(
+                "PUT", "/tbkt/copied.bin",
+                f"partNumber=1&uploadId={upload_id}",
+                headers={"x-amz-copy-source": "/tbkt/partsrc.bin",
+                         "x-amz-copy-source-range":
+                         "bytes=1000-1999"}) as r:
+            body = r.read()
+            assert b"CopyPartResult" in body and b"ETag" in body
+        with s3c.request("PUT", "/tbkt/copied.bin",
+                         f"partNumber=2&uploadId={upload_id}",
+                         data=b"D" * 500):
+            pass
+        complete = (b"<CompleteMultipartUpload>"
+                    b"<Part><PartNumber>1</PartNumber></Part>"
+                    b"<Part><PartNumber>2</PartNumber></Part>"
+                    b"</CompleteMultipartUpload>")
+        with s3c.request("POST", "/tbkt/copied.bin",
+                         f"uploadId={upload_id}", data=complete):
+            pass
+        with s3c.request("GET", "/tbkt/copied.bin") as r:
+            assert r.read() == b"B" * 1000 + b"D" * 500
+
+    def test_copy_part_missing_source_404(self, cluster, s3c):
+        with s3c.request("POST", "/tbkt/nope.bin", "uploads") as r:
+            upload_id = [e.text for e in ET.fromstring(r.read()).iter()
+                         if e.tag.endswith("UploadId")][0]
+        import urllib.error as ue
+        with pytest.raises(ue.HTTPError) as ei:
+            s3c.request("PUT", "/tbkt/nope.bin",
+                        f"partNumber=1&uploadId={upload_id}",
+                        headers={"x-amz-copy-source": "/tbkt/ghost"})
+        assert ei.value.code == 404
+
+
+def test_part_copy_bad_range_and_part_number(cluster, s3c):
+    """InvalidRange/InvalidArgument come back as S3 errors, never a
+    dropped connection (regression)."""
+    import urllib.error as ue
+    with s3c.request("PUT", "/tbkt/small.bin", data=b"tiny"):
+        pass
+    with s3c.request("POST", "/tbkt/pc2.bin", "uploads") as r:
+        upload_id = [e.text for e in ET.fromstring(r.read()).iter()
+                     if e.tag.endswith("UploadId")][0]
+    with pytest.raises(ue.HTTPError) as ei:
+        s3c.request("PUT", "/tbkt/pc2.bin",
+                    f"partNumber=1&uploadId={upload_id}",
+                    headers={"x-amz-copy-source": "/tbkt/small.bin",
+                             "x-amz-copy-source-range":
+                             "bytes=5000-9999"})
+    assert ei.value.code == 416
+    assert b"InvalidRange" in ei.value.read()
+    with pytest.raises(ue.HTTPError) as ei:
+        s3c.request("PUT", "/tbkt/pc2.bin",
+                    f"partNumber=abc&uploadId={upload_id}",
+                    data=b"x")
+    assert ei.value.code == 400
+    assert b"InvalidArgument" in ei.value.read()

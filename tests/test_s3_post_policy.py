@@ -249,3 +249,14 @@ def test_malformed_range_is_400(cluster):
         _post(cluster, body, ctype)
     assert ei.value.code == 400
     assert b"MalformedPOSTRequest" in ei.value.read()
+
+
+def test_quoted_boundary_accepted(cluster):
+    """RFC 2046 allows a quoted boundary parameter; the parser must
+    strip the quotes (regression)."""
+    fields = _policy_fields("quoted.bin")
+    body, ctype = _form(fields, b"quoted boundary bytes")
+    ctype = ctype.replace("boundary=form-boundary-123",
+                          'boundary="form-boundary-123"')
+    with _post(cluster, body, ctype) as r:
+        assert r.status == 204
