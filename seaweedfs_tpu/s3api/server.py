@@ -475,6 +475,8 @@ def _make_handler(s3: S3ApiServer):
         def _copy_object(self, bucket: str, key: str, copy_src: str):
             src = urllib.parse.unquote(copy_src).lstrip("/")
             sbucket, _, skey = src.partition("/")
+            # same source-bucket read check as UploadPartCopy
+            self._auth(ACTION_READ, sbucket)
             entry = s3.find_entry(_dir_of(sbucket, skey), _name_of(skey))
             if entry is None:
                 return self._error("NoSuchKey", src, 404)
@@ -581,25 +583,31 @@ def _make_handler(s3: S3ApiServer):
                 _xml("Key", text=key),
                 _xml("UploadId", text=upload_id))))
 
-        @staticmethod
-        def _part_number(qs):
-            """partNumber as int, or None when non-numeric/absent."""
-            try:
-                return int(qs.get("partNumber", [""])[0])
-            except (ValueError, IndexError):
-                return None
-
-        def _upload_part(self, bucket: str, key: str, qs, payload: bytes):
+        def _multipart_target(self, bucket: str, qs):
+            """(part number, upload dir) for a part request, or None
+            after an error reply — the shared validation preamble of
+            _upload_part and _copy_object_part."""
             upload_id = qs.get("uploadId", [""])[0]
-            part = self._part_number(qs)
-            if part is None:
-                return self._error("InvalidArgument",
-                                   "bad partNumber", 400)
-            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
+            try:
+                part = int(qs.get("partNumber", [""])[0])
+            except (ValueError, IndexError):
+                part = None
+            if part is None or not 1 <= part <= 10000:
+                self._error("InvalidArgument", "bad partNumber", 400)
+                return None
             if s3.find_entry(
                     f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
                     upload_id) is None:
-                return self._error("NoSuchUpload", upload_id, 404)
+                self._error("NoSuchUpload", upload_id, 404)
+                return None
+            return (part,
+                    f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}")
+
+        def _upload_part(self, bucket: str, key: str, qs, payload: bytes):
+            target = self._multipart_target(bucket, qs)
+            if target is None:
+                return
+            part, updir = target
             s3.filer_put(f"{updir}/{part:04d}.part", payload)
             self._reply(200, headers={
                 "ETag": f'"{hashlib.md5(payload).hexdigest()}"'})
@@ -609,30 +617,33 @@ def _make_handler(s3: S3ApiServer):
             s3api_object_copy_handlers.go CopyObjectPartHandler): a
             part sourced from an existing object, optionally a byte
             range via x-amz-copy-source-range."""
-            upload_id = qs.get("uploadId", [""])[0]
-            part = self._part_number(qs)
-            if part is None:
-                return self._error("InvalidArgument",
-                                   "bad partNumber", 400)
-            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
-            if s3.find_entry(
-                    f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}",
-                    upload_id) is None:
-                return self._error("NoSuchUpload", upload_id, 404)
+            target = self._multipart_target(bucket, qs)
+            if target is None:
+                return
+            part, updir = target
             src = urllib.parse.unquote(
                 self.headers["x-amz-copy-source"]).lstrip("/")
             sbucket, _, skey = src.partition("/")
+            # reading the SOURCE needs read rights on ITS bucket — the
+            # destination write auth alone must not exfiltrate another
+            # bucket's data
+            self._auth(ACTION_READ, sbucket)
             if s3.find_entry(_dir_of(sbucket, skey),
                              _name_of(skey)) is None:
                 return self._error("NoSuchKey", src, 404)
             rng = self.headers.get("x-amz-copy-source-range")
+            if rng and not rng.startswith("bytes="):
+                return self._error("InvalidArgument",
+                                   f"bad range {rng!r}", 400)
             try:
                 _, data, _ = s3.filer_get(
                     f"{BUCKETS_DIR}/{sbucket}/{skey}", rng)
             except urllib.error.HTTPError as e:
                 if e.code == 416:
                     return self._error("InvalidRange", rng or "", 416)
-                return self._error("NoSuchKey", src, e.code)
+                return self._error("InternalError",
+                                   f"source read failed: {e.code}",
+                                   e.code)
             s3.filer_put(f"{updir}/{part:04d}.part", data)
             self._reply(200, _render(_xml(
                 "CopyPartResult",

@@ -604,3 +604,97 @@ def test_part_copy_bad_range_and_part_number(cluster, s3c):
                     data=b"x")
     assert ei.value.code == 400
     assert b"InvalidArgument" in ei.value.read()
+
+
+def test_presigned_get_url(cluster, s3c):
+    """SigV4 presigned GET: no Authorization header, credentials ride
+    the query string (reference auth_signature_v4.go presigned flow)."""
+    with s3c.request("PUT", "/tbkt/presigned.txt", data=b"presigned ok"):
+        pass
+    t = time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", t)
+    date = time.strftime("%Y%m%d", t)
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    path = "/tbkt/presigned.txt"
+    params = [
+        ("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+        ("X-Amz-Credential", f"{ACCESS}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", "300"),
+        ("X-Amz-SignedHeaders", "host"),
+    ]
+    cq = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(params))
+    creq = "\n".join([
+        "GET", path, cq,
+        f"host:{cluster.s3.url}\n", "host", "UNSIGNED-PAYLOAD"])
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+    k = h(("AWS4" + SECRET).encode(), date)
+    k = h(h(h(k, "us-east-1"), "s3"), "aws4_request")
+    sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+    url = (f"http://{cluster.s3.url}{path}?{cq}"
+           f"&X-Amz-Signature={sig}")
+    with urllib.request.urlopen(url, timeout=30) as r:
+        assert r.read() == b"presigned ok"
+    # a tampered signature is rejected
+    bad = url[:-4] + "0000"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad, timeout=30)
+    assert ei.value.code == 403
+
+
+def test_copy_source_requires_read_on_source_bucket(cluster, s3c, tmp_path):
+    """Write access to the destination must not read another bucket's
+    data through CopyObject/UploadPartCopy (regression: cross-bucket
+    exfiltration)."""
+    import urllib.error as ue
+
+    from seaweedfs_tpu.s3api.auth import (ACTION_LIST, ACTION_READ,
+                                          ACTION_WRITE, Credential,
+                                          Iam, Identity)
+    # a second gateway over the same filer with a scoped identity
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from tests.cluster_util import free_port_pair
+    from tests.test_s3 import SigV4Client
+    with s3c.request("PUT", "/secretbkt"):
+        pass
+    with s3c.request("PUT", "/secretbkt/hidden.txt", data=b"classified"):
+        pass
+    scoped = Iam([Identity(
+        name="scoped", credentials=[Credential("SCOPED", "SK2")],
+        actions=[f"{ACTION_READ}:tbkt", f"{ACTION_WRITE}:tbkt",
+                 f"{ACTION_LIST}:tbkt"])])
+    gw = S3ApiServer(filer_url=cluster.filer.url, port=free_port_pair(),
+                     iam=scoped)
+    gw.start()
+    try:
+        sc = SigV4Client(gw.url, "SCOPED", "SK2")
+        with pytest.raises(ue.HTTPError) as ei:
+            sc.request("PUT", "/tbkt/steal.bin",
+                       headers={"x-amz-copy-source":
+                                "/secretbkt/hidden.txt"})
+        assert ei.value.code == 403
+        with sc.request("POST", "/tbkt/steal2.bin", "uploads") as r:
+            uid = [e.text for e in ET.fromstring(r.read()).iter()
+                   if e.tag.endswith("UploadId")][0]
+        with pytest.raises(ue.HTTPError) as ei:
+            sc.request("PUT", "/tbkt/steal2.bin",
+                       f"partNumber=1&uploadId={uid}",
+                       headers={"x-amz-copy-source":
+                                "/secretbkt/hidden.txt"})
+        assert ei.value.code == 403
+        # malformed range form is InvalidArgument, not a silent full copy
+        with pytest.raises(ue.HTTPError) as ei:
+            sc.request("PUT", "/tbkt/steal2.bin",
+                       f"partNumber=1&uploadId={uid}",
+                       headers={"x-amz-copy-source": "/tbkt/presigned.txt",
+                                "x-amz-copy-source-range": "0-99"})
+        assert ei.value.code == 400
+    finally:
+        gw.stop()
