@@ -87,6 +87,25 @@ def make_filer_store(store: str, meta_dir: Optional[str],
         "redis | etcd | mysql | postgres)")
 
 
+def _advance_and_filter(events, prefix: str, since: int):
+    """(new_since, matching events) for a subscription poll.
+
+    `since` advances past EVERY scanned record, matching or not.
+    Streaming loops must use THIS — not the readers' own path_prefix
+    parameters — because reader-side filtering hides the timestamps
+    needed to advance `since`, and a subscriber whose prefix matches
+    nothing then spins at 100% CPU re-scanning the log forever.
+    """
+    from seaweedfs_tpu.filer.filer_notify import MetaLog
+    matching = []
+    for ev in events:
+        since = max(since, ev.ts_ns)
+        if prefix and not MetaLog._matches_prefix(ev, prefix):
+            continue
+        matching.append(ev)
+    return since, matching
+
+
 class FilerServer:
     def __init__(self, master_url: str, ip: str = "127.0.0.1",
                  port: int = 8888, store: str = "memory",
@@ -405,17 +424,21 @@ class FilerServer:
 
     def SubscribeMetadata(self, request, context):
         """Cluster-wide merged stream when peers are configured (the
-        MetaAggregator view); the local log otherwise."""
+        MetaAggregator view); the local log otherwise.
+
+        `since` advances past EVERY scanned record, matching or not —
+        advancing only on yielded records made a prefix subscriber spin
+        at 100% CPU once any unrelated event existed (the wait call saw
+        newer data and returned immediately, forever)."""
         if self.meta_aggregator is not None:
             agg = self.meta_aggregator
             since = request.since_ns
             while context.is_active() and not self._stopping:
                 ver = agg.version  # read BEFORE scanning: no lost wakeups
-                events = agg.events_since(
-                    since, path_prefix=request.path_prefix)
-                for ev in events:
-                    yield ev
-                    since = max(since, ev.ts_ns)
+                events = agg.events_since(since)
+                since, matching = _advance_and_filter(
+                    events, request.path_prefix, since)
+                yield from matching
                 if not events:
                     agg.wait_for_version(ver, timeout=0.5)
             return
@@ -424,11 +447,10 @@ class FilerServer:
     def SubscribeLocalMetadata(self, request, context):
         since = request.since_ns
         while context.is_active() and not self._stopping:
-            events = self.filer.meta_log.read_events_since(
-                since, path_prefix=request.path_prefix)
-            for ev in events:
-                yield ev
-                since = max(since, ev.ts_ns)
+            events = self.filer.meta_log.read_events_since(since)
+            since, matching = _advance_and_filter(
+                events, request.path_prefix, since)
+            yield from matching
             if not events:
                 self.filer.meta_log.wait_for_data(since, timeout=0.5)
 

@@ -285,3 +285,48 @@ def test_filer_html_directory_browser(cluster):
         import json
         data = json.load(r)
     assert data["Entries"][0]["FullPath"].endswith("page.txt")
+
+
+def test_prefix_subscriber_does_not_spin_on_unrelated_events(cluster):
+    """A SubscribeMetadata client with a path prefix must BLOCK between
+    polls when only non-matching events exist (regression: the filer
+    burned 100% CPU re-scanning the log forever because filtered-out
+    events never advanced `since`)."""
+    import threading
+    import time
+
+    from seaweedfs_tpu.filer import http_client
+    from seaweedfs_tpu.filer.filer_notify import MetaLog
+    from seaweedfs_tpu.pb import filer_pb2, filer_stub
+
+    calls = {"n": 0}
+    real = MetaLog.read_events_since
+
+    def counting(self, *a, **kw):
+        calls["n"] += 1
+        return real(self, *a, **kw)
+    MetaLog.read_events_since = counting
+    try:
+        stub = filer_stub(cluster.filer.url)
+        stream = stub.SubscribeMetadata(
+            filer_pb2.SubscribeMetadataRequest(
+                client_name="spin-test", path_prefix="/never-matches/",
+                since_ns=time.time_ns()))
+        got = []
+        t = threading.Thread(
+            target=lambda: got.extend(rec.ts_ns for rec in stream),
+            daemon=True)
+        t.start()
+        time.sleep(0.5)
+        # unrelated traffic: events exist but none match the prefix
+        for i in range(5):
+            http_client.put(cluster.filer.url, f"/other/f{i}.txt", b"x")
+        calls["n"] = 0
+        time.sleep(2.0)
+        stream.cancel()
+        # a healthy loop polls at the 0.5s wait cadence: ~4 scans in 2s.
+        # the spin re-scanned hundreds of times per second.
+        assert calls["n"] <= 10, f"subscribe loop spun: {calls['n']} scans"
+        assert not got
+    finally:
+        MetaLog.read_events_since = real

@@ -690,10 +690,12 @@ def test_copy_source_requires_read_on_source_bucket(cluster, s3c, tmp_path):
                                 "/secretbkt/hidden.txt"})
         assert ei.value.code == 403
         # malformed range form is InvalidArgument, not a silent full copy
+        with s3c.request("PUT", "/tbkt/rangesrc.bin", data=b"r" * 200):
+            pass
         with pytest.raises(ue.HTTPError) as ei:
             sc.request("PUT", "/tbkt/steal2.bin",
                        f"partNumber=1&uploadId={uid}",
-                       headers={"x-amz-copy-source": "/tbkt/presigned.txt",
+                       headers={"x-amz-copy-source": "/tbkt/rangesrc.bin",
                                 "x-amz-copy-source-range": "0-99"})
         assert ei.value.code == 400
     finally:

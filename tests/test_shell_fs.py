@@ -186,3 +186,25 @@ def test_volume_fsck_counts_manifest_chunks(cluster, shell):
     out = shell.run_command("volume.fsck")
     # neither the manifest blob nor the inner chunks are orphans
     assert " 0 orphans" in out
+
+
+def test_volume_fsck_covers_ec_volumes(cluster, shell):
+    """fsck must read EC volumes' .ecx indexes too: after ec.encode,
+    filer-referenced chunks living in EC shards are still not
+    orphans."""
+    from seaweedfs_tpu.operation.file_id import parse_fid
+    http_client.put(cluster.filer.url, "/ecfsck/data.bin",
+                    b"E" * 40000)
+    entry = cluster.filer.filer.find_entry("/ecfsck/data.bin")
+    vid = parse_fid(entry.chunks[0].file_id).volume_id
+    out = shell.run_command(f"ec.encode -volumeId={vid} -encoder=numpy")
+    assert "done" in out
+    cluster.wait_for(lambda: cluster.master.topo.lookup_ec(vid),
+                     what="ec registration")
+    out = shell.run_command("volume.fsck -v")
+    assert f"volume {vid}" in out          # the EC volume was scanned
+    assert " 0 orphans" in out
+    # and the file still reads through the EC path
+    status, body, _ = http_client.get(cluster.filer.url,
+                                      "/ecfsck/data.bin")
+    assert status == 200 and body == b"E" * 40000
