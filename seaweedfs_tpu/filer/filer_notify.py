@@ -17,6 +17,26 @@ from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.util.log_buffer import LogBuffer, LogEntry
 
 
+def matches_prefix(rec: filer_pb2.SubscribeMetadataResponse,
+                   prefix: str) -> bool:
+    """Does the event touch a path under `prefix`? — the one filter
+    applied at subscription yield sites, like the reference's
+    eachEventNotificationFn (filer_grpc_server_sub_meta.go)."""
+    ev = rec.event_notification
+    base = rec.directory.rstrip("/")
+    for name in (ev.new_entry.name, ev.old_entry.name):
+        if name and f"{base}/{name}".startswith(prefix):
+            return True
+    if ev.new_parent_path and \
+            f"{ev.new_parent_path.rstrip('/')}/{ev.new_entry.name}" \
+            .startswith(prefix):
+        return True
+    # events carrying no entry (bare markers): match on directory
+    if not ev.new_entry.name and not ev.old_entry.name:
+        return rec.directory.startswith(prefix)
+    return False
+
+
 def event_key(directory: str, ev: filer_pb2.EventNotification) -> str:
     """The canonical notification key for an event: the ENTRY's full
     path under its (old) parent directory — renames keyed by the OLD
@@ -104,9 +124,15 @@ class MetaLog:
         return out
 
     def read_events_since(
-            self, since_ns: int,
-            path_prefix: str = "") -> List[filer_pb2.SubscribeMetadataResponse]:
-        """Disk segments + in-memory buffer, deduped by ts, ordered."""
+            self, since_ns: int
+    ) -> List[filer_pb2.SubscribeMetadataResponse]:
+        """Disk segments + in-memory buffer, deduped by ts, ordered.
+
+        Deliberately UNFILTERED: streaming loops must see every record
+        so their cursor advances — prefix filtering happens at the
+        yield site (server/filer.py _advance_and_filter) where the
+        scanned timestamps are still visible. A reader-side prefix
+        filter here once made prefix subscribers spin at 100% CPU."""
         seen = set()
         entries: List[LogEntry] = []
         for e in self._disk_entries(since_ns) + self.buffer.read_since(since_ns):
@@ -120,29 +146,8 @@ class MetaLog:
             rec = filer_pb2.SubscribeMetadataResponse()
             rec.ParseFromString(e.data)
             rec.ts_ns = e.ts_ns
-            if path_prefix and not self._matches_prefix(rec, path_prefix):
-                continue
             out.append(rec)
         return out
-
-    @staticmethod
-    def _matches_prefix(rec: filer_pb2.SubscribeMetadataResponse,
-                        prefix: str) -> bool:
-        """Filter on the full affected entry path, like the reference's
-        eachEventNotificationFn (filer_grpc_server_sub_meta.go)."""
-        ev = rec.event_notification
-        base = rec.directory.rstrip("/")
-        for name in (ev.new_entry.name, ev.old_entry.name):
-            if name and f"{base}/{name}".startswith(prefix):
-                return True
-        if ev.new_parent_path and \
-                f"{ev.new_parent_path.rstrip('/')}/{ev.new_entry.name}" \
-                .startswith(prefix):
-            return True
-        # events carrying no entry (bare markers): match on directory
-        if not ev.new_entry.name and not ev.old_entry.name:
-            return rec.directory.startswith(prefix)
-        return False
 
     def wait_for_data(self, after_ts_ns: int, timeout: float) -> bool:
         return self.buffer.wait_for_data(after_ts_ns, timeout)

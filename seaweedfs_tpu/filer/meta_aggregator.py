@@ -179,14 +179,12 @@ class MetaAggregator:
 
     # -- merged read side ------------------------------------------------------
 
-    def events_since(self, ts_ns: int, path_prefix: str = ""
+    def events_since(self, ts_ns: int
                      ) -> List[filer_pb2.SubscribeMetadataResponse]:
-        """Merged view: local log + peer log, one local clock, one
-        (identical) path filter — MetaLog applies it for both."""
-        local = self.filer.meta_log.read_events_since(
-            ts_ns, path_prefix=path_prefix)
-        peers = self.aggr_log.read_events_since(
-            ts_ns, path_prefix=path_prefix)
+        """Merged view: local log + peer log, one local clock.
+        Unfiltered on purpose — see MetaLog.read_events_since."""
+        local = self.filer.meta_log.read_events_since(ts_ns)
+        peers = self.aggr_log.read_events_since(ts_ns)
         out = list(local) + list(peers)
         out.sort(key=lambda e: e.ts_ns)
         return out

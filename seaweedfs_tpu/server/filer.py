@@ -96,11 +96,11 @@ def _advance_and_filter(events, prefix: str, since: int):
     needed to advance `since`, and a subscriber whose prefix matches
     nothing then spins at 100% CPU re-scanning the log forever.
     """
-    from seaweedfs_tpu.filer.filer_notify import MetaLog
+    from seaweedfs_tpu.filer.filer_notify import matches_prefix
     matching = []
     for ev in events:
         since = max(since, ev.ts_ns)
-        if prefix and not MetaLog._matches_prefix(ev, prefix):
+        if prefix and not matches_prefix(ev, prefix):
             continue
         matching.append(ev)
     return since, matching

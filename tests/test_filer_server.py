@@ -313,9 +313,14 @@ def test_prefix_subscriber_does_not_spin_on_unrelated_events(cluster):
                 client_name="spin-test", path_prefix="/never-matches/",
                 since_ns=time.time_ns()))
         got = []
-        t = threading.Thread(
-            target=lambda: got.extend(rec.ts_ns for rec in stream),
-            daemon=True)
+
+        def consume():
+            import grpc
+            try:
+                got.extend(rec.ts_ns for rec in stream)
+            except grpc.RpcError:
+                pass   # the cancel() below ends the stream
+        t = threading.Thread(target=consume, daemon=True)
         t.start()
         time.sleep(0.5)
         # unrelated traffic: events exist but none match the prefix

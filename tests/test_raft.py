@@ -79,8 +79,12 @@ def test_leader_failover_new_leader_emerges(tmp_path):
         new_leader = _wait_for(lambda: _leader_of(survivors),
                                what="failover leader")
         assert new_leader is not leader
-        # replicated state survived the failover
-        assert new_leader.topo.next_volume_id >= 8
+        # replicated state survived the failover. The committed entry
+        # is guaranteed to be in the new leader's LOG, but raft only
+        # advances its apply point after an entry of its own term
+        # replicates — so wait, don't assert instantly.
+        _wait_for(lambda: new_leader.topo.next_volume_id >= 8,
+                  what="replicated state applied on the new leader")
         # and the new leader can commit with the remaining quorum
         new_leader.raft.propose({"op": "max_volume_id", "value": 99})
         _wait_for(lambda: all(m.topo.next_volume_id >= 100
