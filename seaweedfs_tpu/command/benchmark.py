@@ -132,6 +132,13 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
             with counter_lock:
                 return next(rcounter, None)
 
+        # Reads resolve fids through the KeepConnected vid cache like the
+        # reference's readFiles (benchmark.go: masterClient.LookupFileId),
+        # not a lookup RPC per read.
+        from seaweedfs_tpu.wdclient.masterclient import MasterClient
+        mc = MasterClient([master]).start()
+        mc.wait_until_connected()
+
         def reader():
             rng = random.Random(threading.get_ident())
             while True:
@@ -141,7 +148,7 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
                 fid = fids[rng.randrange(len(fids))]
                 t0 = time.monotonic()
                 try:
-                    data = operations.download(master, fid)
+                    data = operations.download_url(mc.lookup_file_id(fid))
                     rstats.add(time.monotonic() - t0, len(data))
                 except Exception:
                     rstats.fail()
@@ -154,6 +161,7 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
         for th in threads:
             th.join()
         read_s = time.monotonic() - t0
+        mc.stop()
         rstats.report(f"benchmark: random read {n} files, "
                       f"c={concurrency}", read_s, out)
 

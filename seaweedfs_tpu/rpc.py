@@ -22,6 +22,8 @@ GRPC_PORT_OFFSET = 10000
 
 _channel_lock = threading.Lock()
 _channels: Dict[str, grpc.Channel] = {}
+_channel_generation = 0  # bumped on close_channels; invalidates stub cache
+_stub_cache: Dict[tuple, object] = {}
 
 # process-wide TLS (security/tls.py configure_process_tls). None =
 # plaintext, matching the reference's default when security.toml has no
@@ -69,10 +71,13 @@ def cached_channel(address: str) -> grpc.Channel:
 
 
 def close_channels() -> None:
+    global _channel_generation
     with _channel_lock:
         for ch in _channels.values():
             ch.close()
         _channels.clear()
+        _channel_generation += 1
+        _stub_cache.clear()
 
 
 class _MethodSpec:
@@ -94,7 +99,15 @@ def _service_specs(pb2_module, service_name: str):
 
 
 def make_stub(pb2_module, service_name: str, target: str):
-    """A stub object with one callable per RPC, like codegen'd stubs."""
+    """A stub object with one callable per RPC, like codegen'd stubs.
+
+    Stubs are cached per (service, target): building one walks the
+    service descriptor and allocates a multicallable per RPC, which is
+    far too expensive to repeat on every data-plane request."""
+    key = (id(pb2_module), service_name, target, _channel_generation)
+    stub = _stub_cache.get(key)
+    if stub is not None:
+        return stub
     _, specs = _service_specs(pb2_module, service_name)
     channel = cached_channel(target)
     stub = type(f"{service_name}Stub", (), {})()
@@ -111,7 +124,8 @@ def make_stub(pb2_module, service_name: str, target: str):
             spec.path,
             request_serializer=spec.req_cls.SerializeToString,
             response_deserializer=spec.resp_cls.FromString))
-    return stub
+    with _channel_lock:
+        return _stub_cache.setdefault(key, stub)
 
 
 def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcHandler:

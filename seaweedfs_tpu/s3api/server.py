@@ -14,7 +14,9 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
+
+from seaweedfs_tpu.util.http_server import FastHandler
 from typing import Dict, List, Optional, Tuple
 
 import grpc
@@ -200,8 +202,9 @@ def _error_xml(code: str, message: str, resource: str) -> bytes:
 
 
 def _make_handler(s3: S3ApiServer):
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # small replies must not wait on delayed ACKs
 
         def log_message(self, fmt, *args):
             pass

@@ -14,7 +14,9 @@ import json
 import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
+
+from seaweedfs_tpu.util.http_server import FastHandler
 from typing import List, Optional
 
 import grpc
@@ -540,8 +542,9 @@ def _entry_json(e: filer_pb2.Entry, directory: str) -> dict:
 
 
 def _make_http_handler(fs: FilerServer):
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # small replies must not wait on delayed ACKs
 
         def log_message(self, fmt, *args):
             pass

@@ -18,7 +18,9 @@ import threading
 import time
 
 import grpc
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
+
+from seaweedfs_tpu.util.http_server import FastHandler
 from typing import Dict, List, Optional, Set
 from urllib.parse import parse_qs, urlparse
 
@@ -736,8 +738,9 @@ class MasterServer:
 
 
 def _make_http_handler(ms: MasterServer):
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # small replies must not wait on delayed ACKs
 
         def log_message(self, fmt, *args):  # quiet
             pass

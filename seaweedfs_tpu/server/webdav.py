@@ -16,7 +16,9 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
+
+from seaweedfs_tpu.util.http_server import FastHandler
 from typing import List, Optional
 
 import grpc
@@ -111,8 +113,9 @@ def _prop_response(href: str, entry: filer_pb2.Entry) -> ET.Element:
 
 
 def _make_handler(dav: WebDavServer):
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # small replies must not wait on delayed ACKs
 
         def log_message(self, fmt, *args):
             pass

@@ -1,0 +1,270 @@
+"""Pooled keep-alive HTTP/1.1 client for the data plane.
+
+The reference's data path rides Go's http.Client, which pools
+persistent connections per host (net/http Transport) and parses
+responses with a tight byte-loop (net/textproto). The stdlib pair
+(urllib / http.client) costs a fresh TCP connection per request in
+urllib's case and an email-module header parse per response in both —
+at small-file request rates that parsing is a measurable share of the
+whole data plane. This module is the Go-client idea in plain sockets:
+
+  - process-wide pool of persistent connections keyed by netloc
+    (moral equivalent of weed/util/http_util.go:17-29's shared client)
+  - TCP_NODELAY (small requests must not wait on delayed ACKs)
+  - one sendall per request (headers + body in one buffer)
+  - hand-rolled response parse into a lowercase-keyed dict
+  - Content-Length, chunked, and read-to-close bodies
+  - one retry when a pooled connection turns out stale
+
+Only plain http is spoken here — this is the cluster-internal data
+plane; TLS-bearing paths (cloud tiers, notification backends) keep
+their own clients.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from seaweedfs_tpu.util.http_server import HeaderDict
+
+_pool_lock = threading.Lock()
+_pool: Dict[str, List["_Conn"]] = {}
+_MAX_IDLE_PER_HOST = 32
+_MAX_LINE = 65536
+
+
+class _Conn:
+    __slots__ = ("netloc", "sock", "rfile")
+
+    def __init__(self, netloc: str, timeout: float):
+        self.netloc = netloc
+        host, _, port = netloc.rpartition(":")
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.rfile = self.sock.makefile("rb", buffering=65536)
+
+    def close(self) -> None:
+        try:
+            self.rfile.close()
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _get_conn(netloc: str, timeout: float) -> Tuple["_Conn", bool]:
+    """Returns (conn, reused)."""
+    with _pool_lock:
+        conns = _pool.get(netloc)
+        if conns:
+            conn = conns.pop()
+            conn.sock.settimeout(timeout)
+            return conn, True
+    return _Conn(netloc, timeout), False
+
+
+def _put_conn(conn: "_Conn") -> None:
+    with _pool_lock:
+        conns = _pool.setdefault(conn.netloc, [])
+        if len(conns) < _MAX_IDLE_PER_HOST:
+            conns.append(conn)
+            return
+    conn.close()
+
+
+def close_all() -> None:
+    """Drop every pooled connection (tests / topology changes)."""
+    with _pool_lock:
+        for conns in _pool.values():
+            for c in conns:
+                c.close()
+        _pool.clear()
+
+
+class Response:
+    __slots__ = ("status", "headers", "body")
+
+    def __init__(self, status: int, headers: "HeaderDict", body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def header(self, name: str, default: str = "") -> str:
+        return self.headers.get(name, default)
+
+
+def request(method: str, url: str, body: Optional[bytes] = None,
+            headers: Optional[dict] = None, timeout: float = 60.0,
+            pooled: bool = True) -> Response:
+    """One HTTP request over a pooled persistent connection.
+
+    `url` is "http://host:port/path?q" or bare "host:port/path?q".
+    Returns the full body bytes.
+    """
+    netloc, path = _split(url)
+    reuse_ok = pooled
+    for attempt in (0, 1):
+        if reuse_ok:
+            conn, reused = _get_conn(netloc, timeout)
+        else:
+            conn, reused = _Conn(netloc, timeout), False
+        try:
+            resp, keep = _roundtrip(conn, netloc, method, path, body,
+                                    headers)
+        except _StaleConnection as e:
+            # retry ONLY when the pooled connection died before the
+            # server can have processed the request (clean close before
+            # the first response byte, or the send itself failing) —
+            # never on timeouts or mid-response failures, which would
+            # re-execute a request the server already ran (Go's
+            # net/http draws the same line)
+            conn.close()
+            if not (reused and e.retryable) or attempt == 1:
+                raise
+            reuse_ok = False
+            continue
+        except OSError:
+            conn.close()
+            raise
+        if keep and pooled:
+            _put_conn(conn)
+        else:
+            conn.close()
+        return resp
+    raise RuntimeError("unreachable")
+
+
+class _StaleConnection(Exception):
+    """Connection-level failure. retryable=True means no response byte
+    arrived AND the request cannot have been durably received (safe to
+    replay on a fresh connection)."""
+
+    def __init__(self, msg, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
+
+
+def _roundtrip(conn: "_Conn", netloc: str, method: str, path: str,
+               body: Optional[bytes],
+               headers: Optional[dict]) -> Tuple[Response, bool]:
+    buf = [f"{method} {path} HTTP/1.1\r\nHost: {netloc}\r\n"
+           "Accept-Encoding: identity\r\n"]
+    has_len = False
+    if headers:
+        for k, v in headers.items():
+            buf.append(f"{k}: {v}\r\n")
+            if k.lower() == "content-length":
+                has_len = True
+    if body is not None and not has_len:
+        buf.append(f"Content-Length: {len(body)}\r\n")
+    elif body is None and method in ("POST", "PUT"):
+        buf.append("Content-Length: 0\r\n")
+    buf.append("\r\n")
+    msg = "".join(buf).encode("latin-1")
+    if body:
+        msg += body
+    try:
+        conn.sock.sendall(msg)
+    except (BrokenPipeError, ConnectionResetError) as e:
+        # the peer closed the idle pooled connection; nothing reached it
+        raise _StaleConnection(str(e), retryable=True)
+
+    rfile = conn.rfile
+    try:
+        line = rfile.readline(_MAX_LINE)
+    except ConnectionResetError as e:
+        # RST before any response byte on a reused connection is the
+        # idle-close race (server dropped the conn as our bytes were in
+        # flight); data-plane requests are idempotent by fid, so replay
+        raise _StaleConnection(str(e), retryable=True)
+    if not line:
+        # clean close before any response byte: the server dropped the
+        # idle keep-alive connection before our request landed
+        raise _StaleConnection(netloc, retryable=True)
+    try:
+        proto, rest = line.split(None, 1)
+        status = int(rest.split(None, 1)[0])
+    except (ValueError, IndexError):
+        raise _StaleConnection(f"bad status line {line!r}")
+    if not proto.startswith(b"HTTP/"):
+        raise _StaleConnection(f"bad proto {line!r}")
+
+    hdrs = HeaderDict()
+    while True:
+        line = rfile.readline(_MAX_LINE)
+        if line in (b"\r\n", b"\n", b""):
+            break
+        colon = line.find(b":")
+        if colon <= 0:
+            continue
+        key = line[:colon].decode("latin-1").strip().lower()
+        if key not in hdrs:  # first value wins, like the server parser
+            dict.__setitem__(hdrs, key,
+                             line[colon + 1:].decode("latin-1").strip())
+
+    keep = proto != b"HTTP/1.0"
+    conn_hdr = hdrs.get("connection", "").lower()
+    if "close" in conn_hdr:
+        keep = False
+    elif proto == b"HTTP/1.0" and "keep-alive" in conn_hdr:
+        keep = True
+
+    # body framing: HEAD and 1xx/204/304 have none regardless of headers
+    if method == "HEAD" or status < 200 or status in (204, 304):
+        return Response(status, hdrs, b""), keep
+    if hdrs.get("transfer-encoding", "").lower().endswith("chunked"):
+        data = _read_chunked(rfile)
+        return Response(status, hdrs, data), keep
+    length = hdrs.get("content-length")
+    if length is not None:
+        try:
+            n = int(length)
+        except ValueError:
+            raise _StaleConnection(f"bad Content-Length {length!r}")
+        data = rfile.read(n) if n else b""
+        if len(data) != n:
+            raise _StaleConnection("short body")
+        return Response(status, hdrs, data), keep
+    # no framing: read to close (HTTP/1.0 style)
+    data = rfile.read()
+    return Response(status, hdrs, data), False
+
+
+def _read_chunked(rfile) -> bytes:
+    parts = []
+    while True:
+        line = rfile.readline(_MAX_LINE)
+        if not line:
+            raise _StaleConnection("truncated chunked body")
+        try:
+            size = int(line.split(b";", 1)[0].strip(), 16)
+        except ValueError:
+            raise _StaleConnection(f"bad chunk size {line!r}")
+        if size == 0:
+            # trailers until blank line
+            while True:
+                t = rfile.readline(_MAX_LINE)
+                if t in (b"\r\n", b"\n", b""):
+                    break
+            return b"".join(parts)
+        chunk = rfile.read(size)
+        if len(chunk) != size:
+            raise _StaleConnection("truncated chunk")
+        parts.append(chunk)
+        rfile.readline(_MAX_LINE)  # trailing CRLF
+
+
+def _split(url: str) -> Tuple[str, str]:
+    if url.startswith("http://"):
+        url = url[7:]
+    elif url.startswith("https://"):
+        raise ValueError("https data path not supported by the pool")
+    slash = url.find("/")
+    if slash < 0:
+        return url, "/"
+    return url[:slash], url[slash:]

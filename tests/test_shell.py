@@ -311,8 +311,13 @@ def test_volume_move_fences_writes(cluster, shell):
     from seaweedfs_tpu.operation import operations
     fid = cluster.upload(b"move me")
     vid = parse_fid(fid).volume_id
-    src = operations.lookup(cluster.master.url, vid)[0]
-    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    locs = operations.lookup(cluster.master.url, vid)
+    src = locs[0]
+    # dst must not hold ANY replica of vid: the shared module cluster
+    # may carry replicated volumes from earlier tests, and VolumeCopy
+    # to a server already holding the volume correctly fails
+    dst = next(vs.url for vs in cluster.volume_servers
+               if vs.url not in locs)
     shell.run_command(f"volume.move -volumeId={vid} "
                       f"-source={src} -target={dst}")
     cluster.wait_for(
@@ -384,8 +389,13 @@ def test_volume_copy_creates_replica(cluster, shell):
     from seaweedfs_tpu.operation import operations
     fid = cluster.upload(b"copy me")
     vid = parse_fid(fid).volume_id
-    src = operations.lookup(cluster.master.url, vid)[0]
-    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    locs = operations.lookup(cluster.master.url, vid)
+    src = locs[0]
+    # dst must not hold ANY replica of vid: the shared module cluster
+    # may carry replicated volumes from earlier tests, and VolumeCopy
+    # to a server already holding the volume correctly fails
+    dst = next(vs.url for vs in cluster.volume_servers
+               if vs.url not in locs)
     shell.run_command(f"volume.copy -volumeId={vid} "
                       f"-source={src} -target={dst}")
     cluster.wait_for(
@@ -466,8 +476,13 @@ def test_volume_move_preserves_readonly(cluster, shell):
     from seaweedfs_tpu.operation import operations
     fid = cluster.upload(b"sealed blob")
     vid = parse_fid(fid).volume_id
-    src = operations.lookup(cluster.master.url, vid)[0]
-    dst = next(vs.url for vs in cluster.volume_servers if vs.url != src)
+    locs = operations.lookup(cluster.master.url, vid)
+    src = locs[0]
+    # dst must not hold ANY replica of vid: the shared module cluster
+    # may carry replicated volumes from earlier tests, and VolumeCopy
+    # to a server already holding the volume correctly fails
+    dst = next(vs.url for vs in cluster.volume_servers
+               if vs.url not in locs)
     shell.run_command(f"volume.mark -volumeId={vid} -readonly")
 
     def seen_readonly():
