@@ -58,6 +58,9 @@ def run_upload(args) -> int:
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-ttl", default="")
+    p.add_argument("-maxMB", dest="max_mb", type=int, default=32,
+                   help="split files larger than this into chunk "
+                        "needles + a manifest (reference upload.go)")
     p.add_argument("files", nargs="+")
     opts = p.parse_args(args)
     from seaweedfs_tpu.operation import operations
@@ -65,10 +68,10 @@ def run_upload(args) -> int:
     for path in opts.files:
         with open(path, "rb") as f:
             data = f.read()
-        fid = operations.upload(
+        fid = operations.submit(
             opts.master, data, filename=os.path.basename(path),
             collection=opts.collection, replication=opts.replication,
-            ttl=opts.ttl)
+            ttl=opts.ttl, max_mb=opts.max_mb)
         results.append({"fileName": os.path.basename(path),
                         "fid": fid, "size": len(data)})
     print(json.dumps(results, indent=2))

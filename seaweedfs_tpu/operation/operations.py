@@ -11,10 +11,10 @@ import gzip as gzip_mod
 import json
 import secrets
 import urllib.parse
-import urllib.request
 from typing import Dict, List, NamedTuple
 
 from seaweedfs_tpu.pb import master_pb2, master_stub, volume_server_pb2, volume_stub
+from seaweedfs_tpu.util import http_client
 
 
 class Assignment(NamedTuple):
@@ -27,6 +27,32 @@ class Assignment(NamedTuple):
 def assign(master_url: str, count: int = 1, replication: str = "",
            collection: str = "", ttl: str = "",
            data_center: str = "") -> Assignment:
+    """Assign a fid via the master's public /dir/assign endpoint
+    (reference's documented API, master_server_handlers.go) over a
+    pooled connection — measurably cheaper per call than a
+    grpc-python round trip on the same box."""
+    params = {"count": str(count)}
+    if replication:
+        params["replication"] = replication
+    if collection:
+        params["collection"] = collection
+    if ttl:
+        params["ttl"] = ttl
+    if data_center:
+        params["dataCenter"] = data_center
+    r = http_client.request(
+        "GET", f"{master_url}/dir/assign?{urllib.parse.urlencode(params)}")
+    out = json.loads(r.body)
+    if out.get("error"):
+        raise RuntimeError(f"assign failed: {out['error']}")
+    return Assignment(out["fid"], out["url"], out.get("publicUrl", ""),
+                      out.get("count", count))
+
+
+def assign_grpc(master_url: str, count: int = 1, replication: str = "",
+                collection: str = "", ttl: str = "",
+                data_center: str = "") -> Assignment:
+    """gRPC Assign (same contract; kept for gRPC-only callers/tests)."""
     resp = master_stub(master_url).Assign(master_pb2.AssignRequest(
         count=count, replication=replication, collection=collection,
         ttl=ttl, data_center=data_center))
@@ -37,13 +63,18 @@ def assign(master_url: str, count: int = 1, replication: str = "",
 
 def upload_data(url_fid: str, data: bytes, filename: str = "",
                 mime: str = "", ttl: str = "", gzip: bool = False,
-                fsync: bool = False, timeout: float = 60.0) -> dict:
-    """POST a blob to "host:port/fid". Optionally gzip-compresses."""
+                fsync: bool = False, is_chunk_manifest: bool = False,
+                timeout: float = 60.0) -> dict:
+    """POST a blob to "host:port/fid". Optionally gzip-compresses.
+    is_chunk_manifest marks the needle as a chunk manifest (?cm=true,
+    reference needle_parse_upload.go:180)."""
     params = {}
     if ttl:
         params["ttl"] = ttl
     if fsync:
         params["fsync"] = "true"
+    if is_chunk_manifest:
+        params["cm"] = "true"
     qs = ("?" + urllib.parse.urlencode(params)) if params else ""
     headers = {}
     if gzip:
@@ -62,12 +93,18 @@ def upload_data(url_fid: str, data: bytes, filename: str = "",
     body = (f"--{boundary}\r\n{part_headers}\r\n").encode() + data + \
         f"\r\n--{boundary}--\r\n".encode()
     headers["Content-Type"] = f"multipart/form-data; boundary={boundary}"
-    req = urllib.request.Request(
-        f"http://{url_fid}{qs}", data=body, method="POST", headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as r:
-        out = json.load(r)
-    if out.get("error"):
-        raise RuntimeError(f"upload failed: {out['error']}")
+    r = http_client.request("POST", f"{url_fid}{qs}", body=body,
+                            headers=headers, timeout=timeout)
+    try:
+        out = json.loads(r.body)
+    except ValueError:
+        out = None
+    if out is None or (isinstance(out, dict) and out.get("error")) or \
+            r.status >= 300:
+        detail = out.get("error") if isinstance(out, dict) else \
+            r.body[:200].decode("latin-1", "replace")
+        raise RuntimeError(
+            f"upload to {url_fid} failed (http {r.status}): {detail}")
     return out
 
 
@@ -80,6 +117,45 @@ def upload(master_url: str, data: bytes, filename: str = "", mime: str = "",
     upload_data(f"{a.url}/{a.fid}", data, filename=filename, mime=mime,
                 ttl=ttl)
     return a.fid
+
+
+def submit(master_url: str, data: bytes, filename: str = "",
+           mime: str = "", replication: str = "", collection: str = "",
+           ttl: str = "", max_mb: int = 0) -> str:
+    """Upload one file, splitting into chunk needles + a manifest when
+    it exceeds max_mb (reference operation/submit.go:128-232). Returns
+    the fid to GET — the manifest's fid for chunked uploads. On any
+    chunk failure the already-uploaded chunks are deleted."""
+    if max_mb <= 0 or len(data) <= max_mb << 20:
+        return upload(master_url, data, filename=filename, mime=mime,
+                      replication=replication, collection=collection,
+                      ttl=ttl)
+    from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
+                                                      ChunkManifest)
+    chunk_size = max_mb << 20
+    cm = ChunkManifest(name=filename, mime=mime, size=len(data))
+    try:
+        for i, off in enumerate(range(0, len(data), chunk_size)):
+            piece = data[off:off + chunk_size]
+            a = assign(master_url, replication=replication,
+                       collection=collection, ttl=ttl)
+            upload_data(f"{a.url}/{a.fid}", piece,
+                        filename=f"{filename}-{i + 1}" if filename else "",
+                        ttl=ttl)
+            cm.chunks.append(ChunkInfo(fid=a.fid, offset=off,
+                                       size=len(piece)))
+        a = assign(master_url, replication=replication,
+                   collection=collection, ttl=ttl)
+        upload_data(f"{a.url}/{a.fid}", cm.marshal(), filename=filename,
+                    mime="application/json", ttl=ttl,
+                    is_chunk_manifest=True)
+        return a.fid
+    except Exception:
+        try:
+            cm.delete_chunks(master_url)
+        except RuntimeError:
+            pass  # best-effort cleanup, like the reference
+        raise
 
 
 def lookup(master_url: str, vid: int, collection: str = "") -> List[str]:
@@ -98,12 +174,18 @@ def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
     urls = lookup(master_url, parse_fid(fid).volume_id)
     if not urls:
         raise RuntimeError(f"no locations for {fid}")
-    with urllib.request.urlopen(f"http://{urls[0]}/{fid}",
-                                timeout=timeout) as r:
-        data = r.read()
-        if r.headers.get("Content-Encoding") == "gzip":
-            data = gzip_mod.decompress(data)
-        return data
+    return download_url(f"{urls[0]}/{fid}", timeout=timeout)
+
+
+def download_url(url_fid: str, timeout: float = 60.0) -> bytes:
+    """GET one needle by volume-server URL (no lookup); pooled."""
+    r = http_client.request("GET", url_fid, timeout=timeout)
+    if r.status >= 300:
+        raise RuntimeError(f"GET {url_fid}: http {r.status}")
+    data = r.body
+    if r.header("Content-Encoding") == "gzip":
+        data = gzip_mod.decompress(data)
+    return data
 
 
 def delete_file(master_url: str, fid: str, timeout: float = 30.0) -> None:
@@ -111,9 +193,9 @@ def delete_file(master_url: str, fid: str, timeout: float = 30.0) -> None:
     urls = lookup(master_url, parse_fid(fid).volume_id)
     if not urls:
         return
-    req = urllib.request.Request(f"http://{urls[0]}/{fid}", method="DELETE")
-    with urllib.request.urlopen(req, timeout=timeout):
-        pass
+    r = http_client.request("DELETE", f"{urls[0]}/{fid}", timeout=timeout)
+    if r.status >= 300:
+        raise RuntimeError(f"delete {fid}: http {r.status}")
 
 
 def delete_files(master_url: str, fids: List[str]) -> List[dict]:

@@ -17,14 +17,15 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import grpc
 
 from seaweedfs_tpu import rpc
-from seaweedfs_tpu.util import wlog
+from seaweedfs_tpu.util import http_client, wlog
+from seaweedfs_tpu.util.http_server import FastHandler
 from seaweedfs_tpu.util.throttler import Throttler
 from seaweedfs_tpu.ec import store_ec
 from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
@@ -38,8 +39,10 @@ from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage import vacuum as vacuum_mod
 from seaweedfs_tpu.storage import volume_backup, volume_tier
 from seaweedfs_tpu.storage.backend import BackendError
-from seaweedfs_tpu.storage.needle import (FLAG_IS_COMPRESSED, CookieMismatch,
-                                          Needle, NeedleError)
+from seaweedfs_tpu.storage.needle import (FLAG_IS_CHUNK_MANIFEST,
+                                          FLAG_IS_COMPRESSED,
+                                          CookieMismatch, Needle,
+                                          NeedleError)
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.superblock import TTL
 from seaweedfs_tpu.storage.volume import VolumeError
@@ -344,6 +347,15 @@ class VolumeServer:
                     got = self._read_needle(f.volume_id, n)
                     if got.cookie != f.cookie:
                         raise CookieMismatch(f"cookie mismatch on {fid}")
+                    if got.is_chunk_manifest:
+                        # cascading here could recurse through this very
+                        # RPC; refuse like the reference
+                        # (volume_grpc_batch_delete.go:62-69)
+                        results.append(volume_server_pb2.DeleteResult(
+                            file_id=fid, status=406,
+                            error="ChunkManifest: not allowed in batch "
+                                  "delete mode."))
+                        continue
                 # replicated like the HTTP DELETE path, so the needle
                 # disappears from every replica, not just this server
                 size = self.replicated_delete(f.volume_id, n)
@@ -841,36 +853,73 @@ class VolumeServer:
     def replicated_write(self, vid: int, n: Needle,
                          fsync: bool = False) -> int:
         """Write locally then fan out the serialized needle to every
-        other replica (reference topology/store_replicate.go:21-94)."""
+        other replica (reference topology/store_replicate.go:21-94).
+
+        Like the reference, a volume whose replica placement says one
+        copy never consults the master for replica locations — the
+        placement is in the superblock, so the common 000 case stays a
+        purely local append."""
         v = self.store.find_volume(vid)
         if v is not None and v.read_only:
             raise NeedleError(f"volume {vid} is read only")
         _, size = self.store.write_needle(vid, n, fsync=fsync)
+        if v is not None and v.replica_placement.copy_count <= 1:
+            return size
         blob = n.to_bytes()
         for url in self._other_replicas(vid):
-            req = urllib.request.Request(
-                f"http://{url}/admin/replicate?volume={vid}",
-                data=blob, method="POST",
-                headers={"Content-Type": "application/octet-stream"})
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                if resp.status >= 300:
-                    raise NeedleError(
-                        f"replicate to {url} failed: {resp.status}")
+            resp = http_client.request(
+                "POST", f"{url}/admin/replicate?volume={vid}",
+                body=blob,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=30)
+            if resp.status >= 300:
+                raise NeedleError(
+                    f"replicate to {url} failed: {resp.status}")
         return size
 
     def replicated_delete(self, vid: int, n: Needle) -> int:
         size = self._delete_needle(vid, n)
+        v = self.store.find_volume(vid)
+        if v is not None and v.replica_placement.copy_count <= 1:
+            return size
         for url in self._other_replicas(vid):
-            req = urllib.request.Request(
-                f"http://{url}/admin/replicate_delete"
+            resp = http_client.request(
+                "POST",
+                f"{url}/admin/replicate_delete"
                 f"?volume={vid}&key={n.id:x}&cookie={n.cookie:08x}",
-                method="POST")
-            with urllib.request.urlopen(req, timeout=30):
-                pass
+                timeout=30)
+            if resp.status >= 300:
+                raise NeedleError(
+                    f"replicate delete to {url} failed: {resp.status}")
         return size
 
 
 # -- HTTP layer ---------------------------------------------------------------
+
+
+def parse_byte_range(rng: str, total: int) -> Tuple[int, int]:
+    """Parse a single "bytes=a-b" / "bytes=a-" / "bytes=-n" header
+    against a payload of `total` bytes. Returns (start, end) inclusive;
+    raises ValueError on anything unsatisfiable (HTTP 416)."""
+    start_s, _, end_s = rng[len("bytes="):].partition("-")
+    if not start_s:  # suffix range: last N bytes
+        start = max(0, total - int(end_s))
+        end = total - 1
+    else:
+        start = int(start_s)
+        end = int(end_s) if end_s else total - 1
+    end = min(end, total - 1)
+    if start > end or start < 0:
+        raise ValueError(f"unsatisfiable range {rng!r} for {total}")
+    return start, end
+
+
+def content_disposition(name: str) -> str:
+    """inline; filename=... with CR/LF/quotes stripped — names can come
+    from attacker-controlled manifest JSON, and a raw CRLF here would
+    split the response into injected headers."""
+    safe = name.replace("\r", "").replace("\n", "").replace('"', "")
+    return f'inline; filename="{safe}"'
 
 
 def parse_multipart(content_type: str, body: bytes):
@@ -895,8 +944,9 @@ def _make_http_handler(vs: VolumeServer):
     from seaweedfs_tpu.stats.metrics import (RequestCounter,
                                              RequestHistogram)
 
-    class Handler(BaseHTTPRequestHandler):
+    class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # small replies must not wait on delayed ACKs
 
         def log_message(self, fmt, *args):
             pass
@@ -976,6 +1026,10 @@ def _make_http_handler(vs: VolumeServer):
             except (NeedleError, EcShardNotFound) as e:
                 self._json({"error": str(e)}, code=404)
                 return
+            if got.is_chunk_manifest and \
+                    params.get("cm", [""])[0] != "false" and \
+                    self._send_chunked(got):
+                return
             self._send_needle(got, params)
 
         do_HEAD = do_GET
@@ -1006,6 +1060,62 @@ def _make_http_handler(vs: VolumeServer):
             self._json({"error": f"volume {f.volume_id} not found"},
                        code=404)
 
+        def _send_chunked(self, got: Needle) -> bool:
+            """Resolve a chunk-manifest needle and stream its sub-chunks
+            (reference volume_server_handlers_read.go:180-216
+            tryHandleChunkedFile). Returns False on a manifest that
+            fails to parse, falling back to raw-needle semantics."""
+            from seaweedfs_tpu.operation.chunked_file import (
+                ChunkedFileReader, load_chunk_manifest)
+            try:
+                cm = load_chunk_manifest(got.data, got.is_compressed)
+            except (ValueError, KeyError, TypeError):
+                log.warning("volume %s: unparseable chunk manifest",
+                            self.path)
+                return False
+            reader = ChunkedFileReader(cm.chunks, vs.current_master)
+            total = reader.total_size
+            headers = {"X-File-Store": "chunked",
+                       "Accept-Ranges": "bytes"}
+            name = cm.name or (got.name.decode("utf-8", "replace")
+                               if got.name else "")
+            if name:
+                headers["Content-Disposition"] = content_disposition(name)
+            if cm.mime and not cm.mime.startswith(
+                    "application/octet-stream"):
+                headers["Content-Type"] = cm.mime
+            status, start, length = 200, 0, total
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                try:
+                    start, end = parse_byte_range(rng, total)
+                except ValueError:
+                    self._reply(416)
+                    return True
+                status = 206
+                length = end - start + 1
+                headers["Content-Range"] = f"bytes {start}-{end}/{total}"
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(length))
+            self.end_headers()
+            if self.command == "HEAD":
+                return True
+            sent = 0
+            try:
+                for block in reader.stream(start, length):
+                    self.wfile.write(block)
+                    sent += len(block)
+            except (RuntimeError, OSError) as e:
+                # headers are gone; all we can do is drop the connection
+                # so the client sees a short body, like the reference's
+                # logged write error
+                log.warning("chunked read %s failed after %d bytes: %s",
+                            self.path, sent, e)
+                self.close_connection = True
+            return True
+
         def _send_needle(self, got: Needle,
                          params: Optional[dict] = None) -> None:
             etag = f'"{got.etag}"'
@@ -1015,8 +1125,8 @@ def _make_http_handler(vs: VolumeServer):
             data = got.data
             headers = {"ETag": etag, "Accept-Ranges": "bytes"}
             if got.name:
-                headers["Content-Disposition"] = \
-                    f'inline; filename="{got.name.decode("utf-8", "replace")}"'
+                headers["Content-Disposition"] = content_disposition(
+                    got.name.decode("utf-8", "replace"))
             mime = got.mime.decode("utf-8", "replace") if got.mime else ""
             if mime:
                 headers["Content-Type"] = mime
@@ -1045,16 +1155,7 @@ def _make_http_handler(vs: VolumeServer):
             rng = self.headers.get("Range")
             if rng and rng.startswith("bytes=") and not got.is_compressed:
                 try:
-                    start_s, _, end_s = rng[len("bytes="):].partition("-")
-                    if not start_s:  # suffix range: last N bytes
-                        start = max(0, len(data) - int(end_s))
-                        end = len(data) - 1
-                    else:
-                        start = int(start_s)
-                        end = int(end_s) if end_s else len(data) - 1
-                    end = min(end, len(data) - 1)
-                    if start > end or start < 0:
-                        raise ValueError
+                    start, end = parse_byte_range(rng, len(data))
                 except ValueError:
                     self._reply(416)
                     return
@@ -1093,9 +1194,13 @@ def _make_http_handler(vs: VolumeServer):
                     return
                 encoding = part_enc or encoding
             ttl_s = params.get("ttl", [""])[0]
+            flags = FLAG_IS_COMPRESSED if encoding.lower() == "gzip" else 0
+            if params.get("cm", [""])[0].lower() == "true":
+                # chunk-manifest needle (reference
+                # needle_parse_upload.go:180: pu.IsChunkedFile)
+                flags |= FLAG_IS_CHUNK_MANIFEST
             n = Needle(id=f.key, cookie=f.cookie, data=data,
-                       flags=FLAG_IS_COMPRESSED
-                       if encoding.lower() == "gzip" else 0,
+                       flags=flags,
                        name=filename.encode() if filename else b"",
                        mime=mime.encode() if mime and
                        mime != "application/octet-stream" else b"",
@@ -1150,10 +1255,34 @@ def _make_http_handler(vs: VolumeServer):
                 if got.cookie != f.cookie:
                     self._json({"error": "cookie mismatch"}, code=403)
                     return
+                chunked_size = None
+                if got.is_chunk_manifest:
+                    # cascade: all sub-chunks must be gone before the
+                    # manifest (reference
+                    # volume_server_handlers_write.go:124-137)
+                    from seaweedfs_tpu.operation.chunked_file import \
+                        load_chunk_manifest
+                    try:
+                        cm = load_chunk_manifest(got.data,
+                                                 got.is_compressed)
+                    except (ValueError, KeyError, TypeError) as e:
+                        self._json({"error":
+                                    f"load chunks manifest: {e}"},
+                                   code=500)
+                        return
+                    try:
+                        cm.delete_chunks(vs.current_master)
+                    except RuntimeError as e:
+                        self._json({"error": f"delete chunks: {e}"},
+                                   code=500)
+                        return
+                    chunked_size = cm.size
                 if params.get("type", [""])[0] == "replicate":
                     size = vs._delete_needle(f.volume_id, n)
                 else:
                     size = vs.replicated_delete(f.volume_id, n)
+                if chunked_size is not None:
+                    size = chunked_size
             except CookieMismatch:
                 self._json({"error": "cookie mismatch"}, code=403)
                 return

@@ -1,0 +1,171 @@
+"""Volume-level chunk manifests: split upload, GET resolve, ranges,
+DELETE cascade, BatchDelete refusal.
+
+Reference behavior: volume_server_handlers_read.go:180-216 (GET),
+volume_server_handlers_write.go:124-137 (DELETE),
+volume_grpc_batch_delete.go:62-69 (refusal),
+operation/submit.go:128-232 + chunked_file.go (client side).
+"""
+
+import json
+import urllib.error
+
+import pytest
+
+from seaweedfs_tpu.operation import operations
+from seaweedfs_tpu.operation.chunked_file import (ChunkInfo, ChunkManifest,
+                                                  load_chunk_manifest)
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.pb import volume_server_pb2, volume_stub
+from tests.cluster_util import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("chunked"), n_volume_servers=2)
+    yield c
+    c.stop()
+
+
+def _payload(n: int) -> bytes:
+    return bytes(i * 31 % 256 for i in range(1024)) * (n // 1024 + 1)
+
+
+# -- manifest codec ----------------------------------------------------------
+
+
+def test_manifest_roundtrip():
+    cm = ChunkManifest(name="big.bin", mime="application/x-thing",
+                       size=300,
+                       chunks=[ChunkInfo("3,0b1f2", 200, 100),
+                               ChunkInfo("1,0a2e1", 0, 200)])
+    out = load_chunk_manifest(cm.marshal())
+    assert out.name == "big.bin" and out.size == 300
+    # chunks come back offset-sorted regardless of input order
+    assert [c.offset for c in out.chunks] == [0, 200]
+    assert out.chunks[0].fid == "1,0a2e1"
+
+
+def test_manifest_compressed():
+    import gzip
+    cm = ChunkManifest(size=5, chunks=[ChunkInfo("1,ab", 0, 5)])
+    out = load_chunk_manifest(gzip.compress(cm.marshal()),
+                              is_compressed=True)
+    assert out.size == 5 and out.chunks[0].fid == "1,ab"
+
+
+def test_manifest_bad_json_raises():
+    with pytest.raises(ValueError):
+        load_chunk_manifest(b"this is not json")
+
+
+# -- e2e through the public data path ----------------------------------------
+
+
+CHUNK = 256 << 10  # submit() takes max_mb; use 1MB pieces via max_mb=1
+
+
+@pytest.fixture(scope="module")
+def chunked_fid(cluster):
+    data = _payload((5 << 20) // 2)  # 2.5MB -> 3 chunks at max_mb=1
+    fid = operations.submit(cluster.master.url, data,
+                            filename="big.bin", mime="application/x-big",
+                            max_mb=1)
+    return fid, data
+
+
+def test_small_submit_stays_unchunked(cluster):
+    data = b"small"
+    fid = operations.submit(cluster.master.url, data, max_mb=1)
+    with cluster.fetch(fid) as r:
+        assert r.read() == data
+        assert "X-File-Store" not in r.headers
+
+
+def test_chunked_get_streams_whole_file(cluster, chunked_fid):
+    fid, data = chunked_fid
+    with cluster.fetch(fid) as r:
+        assert r.status == 200
+        assert r.headers["X-File-Store"] == "chunked"
+        assert r.headers["Content-Type"] == "application/x-big"
+        assert int(r.headers["Content-Length"]) == len(data)
+        assert r.read() == data
+
+
+def test_chunked_get_range_spanning_chunks(cluster, chunked_fid):
+    fid, data = chunked_fid
+    # range crossing the 1MB chunk boundary
+    lo, hi = (1 << 20) - 1000, (1 << 20) + 1000
+    with cluster.fetch(fid,
+                       headers={"Range": f"bytes={lo}-{hi}"}) as r:
+        assert r.status == 206
+        assert r.read() == data[lo:hi + 1]
+        assert r.headers["Content-Range"] == \
+            f"bytes {lo}-{hi}/{len(data)}"
+
+
+def test_chunked_get_suffix_range(cluster, chunked_fid):
+    fid, data = chunked_fid
+    with cluster.fetch(fid, headers={"Range": "bytes=-1234"}) as r:
+        assert r.status == 206
+        assert r.read() == data[-1234:]
+
+
+def test_cm_false_returns_raw_manifest(cluster, chunked_fid):
+    fid, data = chunked_fid
+    with cluster.fetch(fid + "?cm=false") as r:
+        cm = load_chunk_manifest(r.read())
+    assert cm.size == len(data)
+    assert len(cm.chunks) == 3
+    assert "X-File-Store" not in r.headers
+
+
+def test_batch_delete_refuses_manifest(cluster, chunked_fid):
+    fid, _ = chunked_fid
+    urls = operations.lookup(cluster.master.url,
+                             parse_fid(fid).volume_id)
+    resp = volume_stub(urls[0]).BatchDelete(
+        volume_server_pb2.BatchDeleteRequest(file_ids=[fid]))
+    assert resp.results[0].status == 406
+    assert "ChunkManifest" in resp.results[0].error
+    # still readable: nothing was deleted
+    with cluster.fetch(fid) as r:
+        assert r.status == 200
+
+
+def test_chunked_delete_cascades(cluster, chunked_fid):
+    fid, data = chunked_fid
+    with cluster.fetch(fid + "?cm=false") as r:
+        cm = load_chunk_manifest(r.read())
+    chunk_fids = [c.fid for c in cm.chunks]
+    operations.delete_file(cluster.master.url, fid)
+    # manifest gone
+    with pytest.raises(urllib.error.HTTPError):
+        cluster.fetch(fid)
+    # every sub-chunk gone too
+    for cfid in chunk_fids:
+        with pytest.raises(urllib.error.HTTPError):
+            cluster.fetch(cfid)
+
+
+def test_failed_submit_cleans_up_chunks(cluster, monkeypatch):
+    """A chunk-upload failure mid-submit deletes the pieces already
+    uploaded (reference submit.go's DeleteChunks on error)."""
+    data = _payload(3 << 20)
+    uploaded = []
+    real_upload_data = operations.upload_data
+
+    def flaky(url_fid, blob, **kw):
+        if len(uploaded) == 2:
+            raise RuntimeError("injected chunk failure")
+        out = real_upload_data(url_fid, blob, **kw)
+        uploaded.append(url_fid.split("/", 1)[1])
+        return out
+
+    monkeypatch.setattr(operations, "upload_data", flaky)
+    with pytest.raises(RuntimeError, match="injected"):
+        operations.submit(cluster.master.url, data, max_mb=1)
+    monkeypatch.undo()
+    for cfid in uploaded:
+        with pytest.raises(urllib.error.HTTPError):
+            cluster.fetch(cfid)
