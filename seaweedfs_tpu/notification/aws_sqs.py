@@ -99,6 +99,10 @@ class AwsSqsQueue(MessageQueue):
             ("MessageAttribute.1.Value.DataType", "String"),
             ("MessageAttribute.1.Value.StringValue", key),
             ("MessageBody", text_format.MessageToString(event)),
+            # the reference publisher delays every message 10s
+            # (aws_sqs_pub.go SendMessageInput.DelaySeconds); keep
+            # consumer-visible timing identical
+            ("DelaySeconds", "10"),
             ("Version", "2012-11-05")])
 
 

@@ -329,17 +329,25 @@ class KafkaQueue(MessageQueue):
         with self._lock:
             if not self.num_partitions:
                 self._refresh_metadata()
-            partition = partition_for_key(kb, self.num_partitions)
-            batch = encode_record_batch(kb, value,
-                                        int(time.time() * 1000))
-            body = (
-                _string(None)         # transactional_id (Produce v3)
-                + _int16(1)           # acks = leader (sarama WaitForLocal)
-                + _int32(int(self.timeout * 1000))
-                + _int32(1) + _string(self.topic)
-                + _int32(1) + _int32(partition)
-                + _bytes(batch)
-            )
+
+            def build():
+                # partition + request body derive from the CURRENT
+                # metadata; after a refresh both must be recomputed
+                # (sarama re-partitions on retry too) or a re-created/
+                # expanded topic would see the key land off-map
+                partition = partition_for_key(kb, self.num_partitions)
+                batch = encode_record_batch(kb, value,
+                                            int(time.time() * 1000))
+                return partition, (
+                    _string(None)     # transactional_id (Produce v3)
+                    + _int16(1)       # acks = leader (WaitForLocal)
+                    + _int32(int(self.timeout * 1000))
+                    + _int32(1) + _string(self.topic)
+                    + _int32(1) + _int32(partition)
+                    + _bytes(batch)
+                )
+
+            partition, body = build()
             try:
                 self._produce(partition, body)
             except KafkaError as e:
@@ -349,6 +357,7 @@ class KafkaQueue(MessageQueue):
                         e.code not in self._RETRIABLE:
                     raise
                 self._refresh_metadata()
+                partition, body = build()
                 self._produce(partition, body)
 
     def _produce(self, partition: int, body: bytes) -> None:

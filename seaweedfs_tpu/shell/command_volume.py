@@ -190,12 +190,15 @@ def _move_volume(env: CommandEnv, mv: VolumeMove, out,
                 volume_server_pb2.VolumeMarkWritableRequest(
                     volume_id=mv.vid))
         raise
-    env.volume_server(mv.src).VolumeDelete(
-        volume_server_pb2.VolumeDeleteRequest(volume_id=mv.vid))
     if was_readonly:
+        # seal the destination BEFORE the source copy disappears: a
+        # write sneaking in between VolumeDelete and a late re-mark
+        # would land on a volume that must stay sealed
         env.volume_server(mv.dst).VolumeMarkReadonly(
             volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=mv.vid))
-    else:
+    env.volume_server(mv.src).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=mv.vid))
+    if not was_readonly:
         env.volume_server(mv.dst).VolumeMarkWritable(
             volume_server_pb2.VolumeMarkWritableRequest(volume_id=mv.vid))
     out.write(f"volume {mv.vid}: moved {mv.src} -> {mv.dst}\n")
@@ -571,6 +574,22 @@ def volume_fsck(env: CommandEnv, argv: List[str], out) -> None:
 
         # A − B
         total_orphans = total_orphan_bytes = in_use = 0
+        second_pass_keys: Optional[Dict[int, set]] = None
+
+        def rewalk_keys() -> Dict[int, set]:
+            """Fresh namespace view taken immediately before purging:
+            an upload whose CreateEntry landed after the first walk
+            must not have its live chunks deleted (the mtime cutoff
+            alone cannot see entries that arrived during the walk)."""
+            nonlocal filer_keys, n_files
+            saved_keys, saved_n = filer_keys, n_files
+            filer_keys, n_files = {}, 0
+            try:
+                walk("/")
+                return filer_keys
+            finally:
+                filer_keys, n_files = saved_keys, saved_n
+
         for vid, keys in sorted(volume_keys.items()):
             used = filer_keys.get(vid, set())
             orphans = [k for k in keys if k not in used]
@@ -606,6 +625,16 @@ def volume_fsck(env: CommandEnv, argv: List[str], out) -> None:
                         f"-cutoffTimeAgo={args.cutoffTimeAgo:.0f}s — "
                         f"skip purging\n")
                     continue
+                if second_pass_keys is None:
+                    second_pass_keys = rewalk_keys()
+                now_used = second_pass_keys.get(vid, set())
+                confirmed = [k for k in orphans if k not in now_used]
+                if len(confirmed) != len(orphans):
+                    out.write(
+                        f"volume {vid}: {len(orphans) - len(confirmed)} "
+                        f"orphan(s) became referenced since the first "
+                        f"walk — keeping them\n")
+                orphans = confirmed
                 fids = [format_fid(vid, k, 0) for k in orphans]
                 resp = env.volume_server(url).BatchDelete(
                     volume_server_pb2.BatchDeleteRequest(

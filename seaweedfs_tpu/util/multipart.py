@@ -22,17 +22,18 @@ def iter_parts(content_type: str, body: bytes
             boundary = piece[len("boundary="):].strip('"')
     if not boundary:
         raise ValueError("multipart without boundary")
-    delim = b"--" + boundary.encode()
-    for part in body.split(delim)[1:]:
+    # RFC 2046: the delimiter is CRLF + "--" + boundary; binary content
+    # containing "--boundary" mid-line must NOT split. The first
+    # delimiter has no preceding CRLF in the wire form, so prepend one.
+    delim = b"\r\n--" + boundary.encode()
+    for part in (b"\r\n" + body).split(delim)[1:]:
         if part.startswith(b"--"):
             break  # closing delimiter
-        # strip ONLY the framing CRLFs (after the delimiter line and
-        # before the next one) — trailing newlines inside the content
-        # must survive
+        # consume the CRLF that terminates the delimiter line; content
+        # bytes survive untouched (the CRLF before the next delimiter
+        # was part of the delimiter itself)
         if part.startswith(b"\r\n"):
             part = part[2:]
-        if part.endswith(b"\r\n"):
-            part = part[:-2]
         header_blob, sep, data = part.partition(b"\r\n\r\n")
         if not sep:
             continue
