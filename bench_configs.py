@@ -1,6 +1,6 @@
-"""BASELINE.md config-matrix measurements (configs 1-5).
+"""BASELINE.md config-matrix measurements (configs 1-7).
 
-Usage: python bench_configs.py [1|2|3|4|5|all]
+Usage: python bench_configs.py [1|2|3|4|5|6|7|all]
 
 Each config prints one JSON line; results are recorded in BASELINE.md.
 Config definitions come from BASELINE.json / BASELINE.md:
@@ -220,6 +220,16 @@ def config5() -> dict:
             "encode_throttled_200mbps_p99_ms": round(throttled, 2)}
 
 
+def _phase_stats(st, seconds: float) -> dict:
+    ms = sorted(st.latencies_ms)
+    return {
+        "req_per_s": round(st.completed / seconds, 1) if seconds else 0.0,
+        "p50_ms": round(st.percentile(ms, 50), 2),
+        "p99_ms": round(st.percentile(ms, 99), 2),
+        "failed": st.failed,
+    }
+
+
 def config6() -> dict:
     """Write-path A/B: round-1-style synchronous per-write commits vs
     the round-2 group-commit worker (storage/volume.py
@@ -255,18 +265,13 @@ def config6() -> dict:
             r = run_benchmark_programmatic(
                 c.master.url, n=n, concurrency=16, size=1024,
                 do_read=False, out=io.StringIO())
-            st = r["write"]
-            ms = sorted(st.latencies_ms)
-            results[mode] = {
-                "req_per_s": round(st.completed / r["write_seconds"], 1),
-                "p50_ms": round(st.percentile(ms, 50), 2),
-                "p99_ms": round(st.percentile(ms, 99), 2),
-                "failed": st.failed,
-            }
+            results[mode] = _phase_stats(r["write"], r["write_seconds"])
         finally:
             volume_mod.Volume.__init__ = orig
             if c is not None:
                 c.stop()
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
     results["config"] = 6
     results["n"] = n
     results["speedup"] = round(
@@ -275,10 +280,38 @@ def config6() -> dict:
     return results
 
 
+def config7() -> dict:
+    """Small-file data plane, round-4 shape (BASELINE.md config 6b):
+    write + random-read through the public path (HTTP /dir/assign +
+    pooled volume-server HTTP), c=16, 1KB, in-process cluster."""
+    import io
+    import pathlib
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from seaweedfs_tpu.command.benchmark import run_benchmark_programmatic
+    from tests.cluster_util import Cluster
+
+    n = int(os.environ.get("BENCH7_N", 30_000))
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="bench7-"))
+    c = Cluster(tmp, n_volume_servers=1)
+    try:
+        r = run_benchmark_programmatic(
+            c.master.url, n=n, concurrency=16, size=1024,
+            do_read=True, out=io.StringIO())
+    finally:
+        c.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    out = {"config": 7, "n": n}
+    for phase in ("write", "read"):
+        out[phase] = _phase_stats(r[phase], r[f"{phase}_seconds"])
+    return out
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     configs = {"1": config1, "2": config2, "3": config3, "4": config4,
-               "5": config5, "6": config6}
+               "5": config5, "6": config6, "7": config7}
     if which == "all":
         # each config in its own subprocess: config2 initializes the
         # TPU backend in-process, which would make config4's
