@@ -256,6 +256,31 @@ enabled = false
 # the default embedded store
 enabled = true
 dbFile = "./filer.db"
+
+# MongoDB over the OP_MSG wire protocol (no SDK needed); schema matches
+# the reference: filemeta {directory, name, meta} with a unique index.
+[mongodb]
+enabled = false
+uri = "mongodb://localhost:27017"
+database = "seaweedfs"
+
+# Cassandra over the CQL v4 binary protocol (no SDK needed). Create:
+#   CREATE TABLE filemeta (directory varchar, name varchar,
+#                          meta blob, PRIMARY KEY (directory, name));
+[cassandra]
+enabled = false
+keyspace = "seaweedfs"
+hosts = ["localhost:9042"]
+username = ""
+password = ""
+
+# Elasticsearch 7 over plain REST/JSON (no SDK needed); one index per
+# top-level directory plus .seaweedfs_kv_entries for KV pairs.
+[elastic7]
+enabled = false
+servers = ["localhost:9200"]
+username = ""
+password = ""
 """,
     "replication": """\
 # replication.toml (reference command/scaffold.go [source.filer]/[sink.*])

@@ -103,10 +103,15 @@ class Filer:
                 new: Optional[filer_pb2.Entry],
                 delete_chunks: bool = False,
                 new_parent_path: str = "",
-                from_other_cluster: bool = False) -> None:
+                from_other_cluster: bool = False,
+                signatures=()) -> None:
         ev = filer_pb2.EventNotification(
             delete_chunks=delete_chunks,
             is_from_other_cluster=from_other_cluster)
+        # client signatures ride the event so the ORIGINATING mount can
+        # skip its own echo instead of clobbering newer local state
+        # (reference filer_grpc_server.go passes req.Signatures through)
+        ev.signatures.extend(signatures)
         if old is not None:
             ev.old_entry.CopyFrom(old)
         if new is not None:
@@ -136,7 +141,8 @@ class Filer:
 
     def create_entry(self, directory: str, entry: filer_pb2.Entry,
                      o_excl: bool = False,
-                     from_other_cluster: bool = False) -> None:
+                     from_other_cluster: bool = False,
+                     signatures=()) -> None:
         directory = normalize_path(directory)
         self._ensure_parents(directory, from_other_cluster)
         old = None
@@ -157,7 +163,8 @@ class Filer:
             entry.attributes.mtime = _now()
         self.store.insert_entry(directory, entry)
         self._notify(directory, old, entry,
-                     from_other_cluster=from_other_cluster)
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
         if old is not None and not old.is_directory:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
@@ -196,7 +203,8 @@ class Filer:
         return e
 
     def update_entry(self, directory: str, entry: filer_pb2.Entry,
-                     from_other_cluster: bool = False) -> None:
+                     from_other_cluster: bool = False,
+                     signatures=()) -> None:
         directory = normalize_path(directory)
         old = None
         try:
@@ -205,7 +213,8 @@ class Filer:
             pass
         self.store.update_entry(directory, entry)
         self._notify(directory, old, entry,
-                     from_other_cluster=from_other_cluster)
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
         if old is not None and not old.is_directory:
             unused = filechunks.find_unused_file_chunks(
                 list(old.chunks), list(entry.chunks))
@@ -249,7 +258,8 @@ class Filer:
     def delete_entry(self, full_path: str, recursive: bool = False,
                      ignore_recursive_error: bool = False,
                      delete_data: bool = True,
-                     from_other_cluster: bool = False) -> None:
+                     from_other_cluster: bool = False,
+                     signatures=()) -> None:
         directory, name = split_path(full_path)
         try:
             entry = self.store.find_entry(directory, name)
@@ -270,7 +280,8 @@ class Filer:
                 self.store.hardlink_counter(entry.hard_link_id) == 0:
             chunks.extend(entry.chunks)
         self._notify(directory, entry, None, delete_chunks=delete_data,
-                     from_other_cluster=from_other_cluster)
+                     from_other_cluster=from_other_cluster,
+                     signatures=signatures)
         if delete_data and chunks:
             self._delete_chunks(chunks)
 

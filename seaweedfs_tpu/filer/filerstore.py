@@ -105,9 +105,16 @@ class FilerStoreWrapper(FilerStore):
     every link sees one consistent inode and the last unlink reclaims
     it."""
 
-    def __init__(self, store: FilerStore):
+    def __init__(self, store: FilerStore, trust_link_counters: bool = False):
+        # trust_link_counters: store the incoming entry's
+        # hard_link_counter verbatim instead of recomputing locally —
+        # the mount's MetaCache mirrors the filer's authoritative
+        # counters (reference meta_cache wraps its local store in
+        # FilerStoreWrapper and setHardLink stores the entry as sent,
+        # filerstore_hardlink.go:38-50)
         self.store = store
         self.name = store.name
+        self.trust_link_counters = trust_link_counters
 
     def _count(self, op: str):
         FilerStoreCounter.labels(self.name, op).inc()
@@ -137,8 +144,12 @@ class FilerStoreWrapper(FilerStore):
             bytes(old.hard_link_id) != bytes(entry.hard_link_id)
         full = filer_pb2.Entry()
         full.CopyFrom(entry)
-        full.hard_link_counter = counter + 1 if is_new_link else \
-            max(counter, 1)
+        if self.trust_link_counters:
+            full.hard_link_counter = entry.hard_link_counter or \
+                max(counter, 1)
+        else:
+            full.hard_link_counter = counter + 1 if is_new_link else \
+                max(counter, 1)
         self.store.kv_put(self._hl_key(entry.hard_link_id),
                           full.SerializeToString())
         stub = filer_pb2.Entry(name=entry.name,

@@ -96,7 +96,8 @@ _FILL_DIR_T = ctypes.CFUNCTYPE(
 _GETATTR_T = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(Stat))
 _READLINK_T = ctypes.CFUNCTYPE(
-    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t)
+    ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char),
+    ctypes.c_size_t)
 _GETDIR_T = ctypes.CFUNCTYPE(ctypes.c_int)          # deprecated, unused
 _MKNOD_T = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.c_uint, ctypes.c_ulong)
@@ -131,7 +132,17 @@ _RELEASE_T = ctypes.CFUNCTYPE(
 _FSYNC_T = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
     ctypes.POINTER(FuseFileInfo))
-_XATTR4_T = ctypes.CFUNCTYPE(ctypes.c_int)          # unused, NULL
+_SETXATTR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char), ctypes.c_size_t, ctypes.c_int)
+_GETXATTR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char), ctypes.c_size_t)
+_LISTXATTR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_char), ctypes.c_size_t)
+_REMOVEXATTR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
 _OPENDIR_T = ctypes.CFUNCTYPE(
     ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(FuseFileInfo))
 _READDIR_T = ctypes.CFUNCTYPE(
@@ -183,10 +194,10 @@ class FuseOperations(ctypes.Structure):
         ("flush", _FLUSH_T),
         ("release", _RELEASE_T),
         ("fsync", _FSYNC_T),
-        ("setxattr", _XATTR4_T),
-        ("getxattr", _XATTR4_T),
-        ("listxattr", _XATTR4_T),
-        ("removexattr", _XATTR4_T),
+        ("setxattr", _SETXATTR_T),
+        ("getxattr", _GETXATTR_T),
+        ("listxattr", _LISTXATTR_T),
+        ("removexattr", _REMOVEXATTR_T),
         ("opendir", _OPENDIR_T),
         ("readdir", _READDIR_T),
         ("releasedir", _RELEASEDIR_T),
@@ -242,10 +253,14 @@ class FuseMount:
         if entry.is_directory:
             st.contents.st_mode = stat_mod.S_IFDIR | mode
             st.contents.st_nlink = 2
+        elif stat_mod.S_ISLNK(a.file_mode):
+            st.contents.st_mode = stat_mod.S_IFLNK | mode
+            st.contents.st_nlink = 1
+            st.contents.st_size = len(a.symlink_target.encode())
         else:
             from seaweedfs_tpu.filer import filechunks
             st.contents.st_mode = stat_mod.S_IFREG | mode
-            st.contents.st_nlink = 1
+            st.contents.st_nlink = max(1, entry.hard_link_counter)
             # max EXTENT, not sum: overlapping rewrite chunks cover the
             # same byte range and must not inflate the size
             st.contents.st_size = max(
@@ -369,8 +384,104 @@ class FuseMount:
             except BaseException as e:
                 return _errno_of(e)
 
+        UTIME_NOW = (1 << 30) - 1
+        UTIME_OMIT = (1 << 30) - 2
+
         def op_utimens(path, times):
-            return 0  # mtime is set by writes; accept touch silently
+            try:
+                if times:
+                    # times points at [atime, mtime]; libfuse2 passes
+                    # the sentinels in tv_nsec (utimensat(2)): OMIT
+                    # leaves mtime alone, NOW means "current time" with
+                    # tv_sec left 0 — reading tv_sec verbatim would
+                    # stamp files back to 1970 on every `touch`
+                    nsec = times[1].tv_nsec
+                    if nsec == UTIME_OMIT:
+                        return 0
+                    import time as _time
+                    mtime = int(_time.time()) if nsec == UTIME_NOW \
+                        else times[1].tv_sec
+                    shim.wfs.utimens(shim._p(path), mtime)
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_chown(path, uid, gid):
+            try:
+                shim.wfs.chown(shim._p(path), uid, gid)
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_symlink(target, path):
+            # note the argument order: (target, linkpath)
+            try:
+                shim.wfs.symlink(target.decode("utf-8", "replace"),
+                                 shim._p(path))
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_readlink(path, buf, size):
+            try:
+                target = shim.wfs.readlink(shim._p(path)).encode()
+                n = min(len(target), size - 1)
+                ctypes.memmove(buf, target, n)
+                buf[n] = b"\x00"
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_link(old, new):
+            try:
+                shim.wfs.link(shim._p(old), shim._p(new))
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_setxattr(path, name, value, size, flags):
+            try:
+                shim.wfs.setxattr(
+                    shim._p(path), name.decode("utf-8", "replace"),
+                    ctypes.string_at(value, size), flags)
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_getxattr(path, name, buf, size):
+            try:
+                data = shim.wfs.getxattr(
+                    shim._p(path), name.decode("utf-8", "replace"))
+                if size == 0:
+                    return len(data)  # probe call: report needed size
+                if len(data) > size:
+                    return -errno.ERANGE
+                ctypes.memmove(buf, data, len(data))
+                return len(data)
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_listxattr(path, buf, size):
+            try:
+                names = shim.wfs.listxattr(shim._p(path))
+                blob = b"".join(n.encode() + b"\x00" for n in names)
+                if size == 0:
+                    return len(blob)
+                if len(blob) > size:
+                    return -errno.ERANGE
+                if blob:
+                    ctypes.memmove(buf, blob, len(blob))
+                return len(blob)
+            except BaseException as e:
+                return _errno_of(e)
+
+        def op_removexattr(path, name):
+            try:
+                shim.wfs.removexattr(
+                    shim._p(path), name.decode("utf-8", "replace"))
+                return 0
+            except BaseException as e:
+                return _errno_of(e)
 
         def op_access(path, mask):
             try:
@@ -395,8 +506,16 @@ class FuseMount:
         ops.rename = _RENAME_T(op_rename)
         ops.truncate = _TRUNCATE_T(op_truncate)
         ops.chmod = _CHMOD_T(op_chmod)
+        ops.chown = _CHOWN_T(op_chown)
         ops.utimens = _UTIMENS_T(op_utimens)
         ops.access = _ACCESS_T(op_access)
+        ops.symlink = _SYMLINK_T(op_symlink)
+        ops.readlink = _READLINK_T(op_readlink)
+        ops.link = _LINK_T(op_link)
+        ops.setxattr = _SETXATTR_T(op_setxattr)
+        ops.getxattr = _GETXATTR_T(op_getxattr)
+        ops.listxattr = _LISTXATTR_T(op_listxattr)
+        ops.removexattr = _REMOVEXATTR_T(op_removexattr)
         return ops
 
     # -- mount lifecycle -----------------------------------------------------

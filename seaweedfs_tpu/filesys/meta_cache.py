@@ -9,15 +9,26 @@ from typing import Callable, List, Optional
 
 import grpc
 
-from seaweedfs_tpu.filer.filerstore import NotFound, split_path
+from seaweedfs_tpu.filer.filerstore import (FilerStoreWrapper, NotFound,
+                                            split_path)
 from seaweedfs_tpu.filer.stores.memory_store import MemoryStore
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
 
 
 class MetaCache:
-    def __init__(self, filer_url: str):
+    def __init__(self, filer_url: str, signature: int = 0):
         self.filer_url = filer_url
-        self.store = MemoryStore()
+        # events carrying this signature originated from THIS mount:
+        # the local mirror already applied them synchronously, and a
+        # lagging echo must not clobber newer local state (reference
+        # meta_cache_subscribe.go skips own-signature messages)
+        self.signature = signature
+        # the wrapper stores hardlinked entries as stubs over shared
+        # KV meta, so a flush through one link name is visible through
+        # every sibling name (reference meta_cache.go:50 wraps its
+        # local store in FilerStoreWrapper for exactly this)
+        self.store = FilerStoreWrapper(MemoryStore(),
+                                       trust_link_counters=True)
         self._visited = set()          # directories already listed
         self._lock = threading.Lock()
         self._sub_thread: Optional[threading.Thread] = None
@@ -76,7 +87,8 @@ class MetaCache:
             try:
                 self._sub_call = self.stub.SubscribeMetadata(
                     filer_pb2.SubscribeMetadataRequest(
-                        client_name="mount", since_ns=since_ns))
+                        client_name="mount", since_ns=since_ns,
+                        signature=self.signature))
                 for rec in self._sub_call:
                     self._apply(rec)
                     since_ns = max(since_ns, rec.ts_ns)
@@ -90,6 +102,8 @@ class MetaCache:
 
     def _apply(self, rec: filer_pb2.SubscribeMetadataResponse) -> None:
         ev = rec.event_notification
+        if self.signature and self.signature in ev.signatures:
+            return  # own echo: already applied locally at mutation time
         directory = rec.directory
         if ev.old_entry.name and (
                 not ev.new_entry.name

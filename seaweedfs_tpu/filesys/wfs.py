@@ -10,9 +10,10 @@ onto this class; without FUSE it serves as the programmatic mount API
 
 from __future__ import annotations
 
+import os
+import stat as stat_mod
 import threading
 import time
-import urllib.request
 from typing import Dict, List, Optional
 
 import grpc
@@ -98,7 +99,8 @@ class FileHandle:
         self.entry.attributes.mtime = int(time.time())
         directory, _ = split_path(self.path)
         self.wfs.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=directory, entry=self.entry))
+            directory=directory, entry=self.entry,
+            signatures=[self.wfs.signature]))
         self.wfs.meta_cache.insert(directory, self.entry)
 
     def apply_truncate(self, length: int) -> None:
@@ -131,7 +133,14 @@ class Wfs:
         self.collection = collection
         self.replication = replication
         self.flush_bytes = flush_bytes
-        self.meta_cache = MetaCache(filer_url)
+        # per-mount signature: rides every mutation so the metadata
+        # subscription can SKIP this mount's own echoes — without it a
+        # lagging self-event can clobber newer local state (the
+        # reference's wfs.signature serves exactly this purpose,
+        # weed/filesys/wfs.go + meta_cache_subscribe.go)
+        import random
+        self.signature = random.randint(1, 0x7FFFFFFF)
+        self.meta_cache = MetaCache(filer_url, signature=self.signature)
         self.meta_cache.start_subscription(since_ns=time.time_ns())
         self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
         self._handles: Dict[int, FileHandle] = {}
@@ -184,7 +193,8 @@ class Wfs:
         entry.attributes.crtime = int(time.time())
         entry.attributes.mtime = entry.attributes.crtime
         self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=directory, entry=entry))
+            directory=directory, entry=entry,
+            signatures=[self.signature]))
         self.meta_cache.insert(directory, entry)
 
     def create(self, path: str, mode: int = 0o644) -> int:
@@ -194,7 +204,8 @@ class Wfs:
         entry.attributes.crtime = int(time.time())
         entry.attributes.mtime = entry.attributes.crtime
         self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=directory, entry=entry))
+            directory=directory, entry=entry,
+            signatures=[self.signature]))
         self.meta_cache.insert(directory, entry)
         return self.open(path)
 
@@ -232,7 +243,8 @@ class Wfs:
         directory, name = split_path(path)
         self.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
             directory=directory, name=name, is_delete_data=True,
-            is_recursive=True, ignore_recursive_error=True))
+            is_recursive=True, ignore_recursive_error=True,
+            signatures=[self.signature]))
         self.meta_cache.delete(directory, name)
 
     def rmdir(self, path: str) -> None:
@@ -243,18 +255,21 @@ class Wfs:
         directory, name = split_path(path)
         self.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
             directory=directory, name=name, is_delete_data=False,
-            is_recursive=False))
+            is_recursive=False, signatures=[self.signature]))
         self.meta_cache.delete(directory, name)
 
-    def _update_entry(self, path: str, mutate) -> filer_pb2.Entry:
+    def _update_entry(self, path: str, mutate,
+                      touch: bool = True) -> filer_pb2.Entry:
         entry = self.getattr(path)
         e2 = filer_pb2.Entry()
         e2.CopyFrom(entry)
         mutate(e2)
-        e2.attributes.mtime = int(time.time())
+        if touch:
+            e2.attributes.mtime = int(time.time())
         directory, name = split_path(path)
         self.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
-            directory=directory, entry=e2))
+            directory=directory, entry=e2,
+            signatures=[self.signature]))
         self.meta_cache.insert(directory, e2)
         return e2
 
@@ -283,9 +298,112 @@ class Wfs:
         self._update_entry(path, mutate)
 
     def chmod(self, path: str, mode: int) -> None:
+        def mutate(e2):
+            # preserve the file-type bits (symlinks store S_IFLNK here)
+            e2.attributes.file_mode = \
+                (e2.attributes.file_mode & ~0o7777) | (mode & 0o7777)
+        self._update_entry(path, mutate)
+
+    def chown(self, path: str, uid: int, gid: int) -> None:
+        def mutate(e2):
+            # FUSE passes -1 (as unsigned 0xffffffff) for "leave as is"
+            if uid != 0xFFFFFFFF:
+                e2.attributes.uid = uid
+            if gid != 0xFFFFFFFF:
+                e2.attributes.gid = gid
+        self._update_entry(path, mutate)
+
+    def utimens(self, path: str, mtime: int) -> None:
         self._update_entry(
-            path, lambda e2: setattr(e2.attributes, "file_mode",
-                                     mode & 0o7777))
+            path, lambda e2: setattr(e2.attributes, "mtime", mtime),
+            touch=False)
+
+    # -- symlinks / hardlinks (reference filesys/dir_link.go) -----------------
+
+    def symlink(self, target: str, path: str) -> None:
+        directory, name = split_path(path)
+        entry = filer_pb2.Entry(name=name)
+        entry.attributes.file_mode = stat_mod.S_IFLNK | 0o777
+        entry.attributes.symlink_target = target
+        entry.attributes.crtime = int(time.time())
+        entry.attributes.mtime = entry.attributes.crtime
+        entry.attributes.uid = os.getuid()
+        entry.attributes.gid = os.getgid()
+        self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=directory, entry=entry,
+            signatures=[self.signature]))
+        self.meta_cache.insert(directory, entry)
+
+    def readlink(self, path: str) -> str:
+        entry = self.getattr(path)
+        if not stat_mod.S_ISLNK(entry.attributes.file_mode):
+            raise FuseError(22, f"EINVAL: {path} is not a symlink")
+        return entry.attributes.symlink_target
+
+    HARD_LINK_MARKER = b"\x01"
+
+    def link(self, old: str, new: str) -> None:
+        """Hard link: both entries share a hard_link_id; the filer
+        stores the chunk list once under that id (reference
+        dir_link.go Link + filer/filerstore hardlink metadata)."""
+        old_entry = self.getattr(old)
+        if old_entry.is_directory:
+            raise FuseError(1, f"EPERM: cannot hardlink directory {old}")
+        e2 = filer_pb2.Entry()
+        e2.CopyFrom(old_entry)
+        if not e2.hard_link_id:
+            e2.hard_link_id = os.urandom(16) + self.HARD_LINK_MARKER
+            e2.hard_link_counter = 1
+        e2.hard_link_counter += 1
+        od, _ = split_path(old)
+        self.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+            directory=od, entry=e2,
+            signatures=[self.signature]))
+        self.meta_cache.insert(od, e2)
+        nd, nn = split_path(new)
+        ne = filer_pb2.Entry(
+            name=nn, is_directory=False,
+            hard_link_id=e2.hard_link_id,
+            hard_link_counter=e2.hard_link_counter)
+        ne.attributes.CopyFrom(e2.attributes)
+        ne.chunks.extend(e2.chunks)
+        for k, v in e2.extended.items():
+            ne.extended[k] = v
+        self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=nd, entry=ne))
+        self.meta_cache.insert(nd, ne)
+
+    # -- xattrs (reference filesys/xattr.go) ----------------------------------
+
+    XATTR_CREATE = 1
+    XATTR_REPLACE = 2
+
+    def setxattr(self, path: str, name: str, value: bytes,
+                 flags: int = 0) -> None:
+        def mutate(e2):
+            exists = name in e2.extended
+            if flags == self.XATTR_CREATE and exists:
+                raise FuseError(17, f"EEXIST: xattr {name}")
+            if flags == self.XATTR_REPLACE and not exists:
+                raise FuseError(61, f"ENODATA: xattr {name}")
+            e2.extended[name] = value
+        self._update_entry(path, mutate)
+
+    def getxattr(self, path: str, name: str) -> bytes:
+        entry = self.getattr(path)
+        if name not in entry.extended:
+            raise FuseError(61, f"ENODATA: xattr {name}")
+        return bytes(entry.extended[name])
+
+    def listxattr(self, path: str) -> List[str]:
+        return sorted(self.getattr(path).extended.keys())
+
+    def removexattr(self, path: str, name: str) -> None:
+        def mutate(e2):
+            if name not in e2.extended:
+                raise FuseError(61, f"ENODATA: xattr {name}")
+            del e2.extended[name]
+        self._update_entry(path, mutate)
 
     def rename(self, old: str, new: str) -> None:
         od, on = split_path(old)

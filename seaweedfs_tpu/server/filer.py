@@ -14,9 +14,7 @@ import json
 import threading
 import time
 import urllib.parse
-from http.server import ThreadingHTTPServer
-
-from seaweedfs_tpu.util.http_server import FastHandler
+from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from typing import List, Optional
 
 import grpc
@@ -68,6 +66,36 @@ def make_filer_store(store: str, meta_dir: Optional[str],
     if store == "etcd":
         from seaweedfs_tpu.filer.stores.etcd_store import EtcdStore
         return EtcdStore(endpoint=opts.get("servers", "127.0.0.1:2379"))
+    if store == "mongodb":
+        from urllib.parse import urlsplit
+
+        from seaweedfs_tpu.filer.stores.mongodb_store import MongodbStore
+        # canonical URIs carry a /db path, ?options, and credentials;
+        # urlsplit handles all of them (netloc/hostname/port)
+        u = urlsplit(opts.get("uri", "mongodb://localhost:27017"))
+        return MongodbStore(host=u.hostname or "localhost",
+                            port=u.port or 27017,
+                            database=opts.get("database")
+                            or (u.path.lstrip("/") or "seaweedfs"))
+    if store in ("elastic", "elastic7"):
+        from seaweedfs_tpu.filer.stores.elastic_store import ElasticStore
+        servers = opts.get("servers", ["localhost:9200"])
+        if isinstance(servers, str):
+            servers = [servers]
+        return ElasticStore(servers=servers,
+                            username=opts.get("username", ""),
+                            password=opts.get("password", ""))
+    if store == "cassandra":
+        from seaweedfs_tpu.filer.stores.cassandra_store import \
+            CassandraStore
+        hosts = opts.get("hosts", ["localhost:9042"])
+        if isinstance(hosts, str):
+            hosts = [hosts]
+        host, _, port = hosts[0].partition(":")
+        return CassandraStore(host=host, port=int(port or 9042),
+                              keyspace=opts.get("keyspace", "seaweedfs"),
+                              username=opts.get("username", ""),
+                              password=opts.get("password", ""))
     if store == "mysql":
         from seaweedfs_tpu.filer.stores.abstract_sql import MysqlStore
         return MysqlStore(
@@ -86,7 +114,8 @@ def make_filer_store(store: str, meta_dir: Optional[str],
             database=opts.get("database", "seaweedfs"))
     raise ValueError(
         f"unknown filer store {store!r} (memory | sqlite | weedkv | "
-        "redis | etcd | mysql | postgres)")
+        "redis | etcd | mongodb | cassandra | elastic7 | mysql | "
+        "postgres)")
 
 
 def _advance_and_filter(events, prefix: str, since: int):
@@ -203,7 +232,7 @@ class FilerServer:
         handler = rpc.generic_handler(filer_pb2, "SeaweedFiler", self)
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -318,7 +347,8 @@ class FilerServer:
         try:
             self.filer.create_entry(
                 request.directory, request.entry, o_excl=request.o_excl,
-                from_other_cluster=request.is_from_other_cluster)
+                from_other_cluster=request.is_from_other_cluster,
+                signatures=list(request.signatures))
             self._maybe_reload_conf(
                 join_path(request.directory, request.entry.name))
             return filer_pb2.CreateEntryResponse()
@@ -328,7 +358,8 @@ class FilerServer:
     def UpdateEntry(self, request, context):
         self.filer.update_entry(
             request.directory, request.entry,
-            from_other_cluster=request.is_from_other_cluster)
+            from_other_cluster=request.is_from_other_cluster,
+            signatures=list(request.signatures))
         self._maybe_reload_conf(
             join_path(request.directory, request.entry.name))
         return filer_pb2.UpdateEntryResponse()
@@ -346,7 +377,8 @@ class FilerServer:
                 recursive=request.is_recursive,
                 ignore_recursive_error=request.ignore_recursive_error,
                 delete_data=request.is_delete_data,
-                from_other_cluster=request.is_from_other_cluster)
+                from_other_cluster=request.is_from_other_cluster,
+                signatures=list(request.signatures))
             self._maybe_reload_conf(
                 join_path(request.directory, request.name))
             return filer_pb2.DeleteEntryResponse()

@@ -18,9 +18,7 @@ import threading
 import time
 
 import grpc
-from http.server import ThreadingHTTPServer
-
-from seaweedfs_tpu.util.http_server import FastHandler
+from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from typing import Dict, List, Optional, Set
 from urllib.parse import parse_qs, urlparse
 
@@ -163,7 +161,7 @@ class MasterServer:
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}",
             [handler, raft_handler])
         self.raft.start()
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever, name="master-http",

@@ -17,7 +17,6 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from http.server import ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
@@ -25,12 +24,12 @@ import grpc
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.util import http_client, wlog
-from seaweedfs_tpu.util.http_server import FastHandler
+from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from seaweedfs_tpu.util.throttler import Throttler
 from seaweedfs_tpu.ec import store_ec
 from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
 from seaweedfs_tpu.ec.encoder import shard_file_name
-from seaweedfs_tpu.ec.shard_bits import TOTAL_SHARDS
+from seaweedfs_tpu.ec.shard_bits import DATA_SHARDS, TOTAL_SHARDS
 from seaweedfs_tpu.operation.file_id import parse_fid
 from seaweedfs_tpu.pb import (master_pb2, master_stub, volume_server_pb2,
                               volume_stub)
@@ -50,7 +49,13 @@ from seaweedfs_tpu.storage.volume import VolumeError
 log = wlog.logger("volume")
 
 COPY_CHUNK = 1 << 20
-EC_LOCATION_TTL = 60.0  # seconds a cached shard-location set stays fresh
+# EC shard-location freshness is tiered by how complete the cached view
+# is (reference storage/store_ec.go:221-231): a sparse view (fewer than
+# DATA_SHARDS known) re-asks the master after 11s, a readable-but-
+# incomplete view after 7m, a complete view only after 37m
+EC_REFRESH_SPARSE_S = 11.0
+EC_REFRESH_PARTIAL_S = 7 * 60.0
+EC_REFRESH_FULL_S = 37 * 60.0
 
 
 class VolumeServer:
@@ -104,7 +109,7 @@ class VolumeServer:
             volume_server_pb2, "VolumeServer", self)
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -797,9 +802,11 @@ class VolumeServer:
 
     def _make_remote_reader(self, vid: int):
         def remote_reader(shard_id: int, offset: int, length: int):
+            tried = False
             for url in self._ec_shard_locations(vid).get(shard_id, []):
                 if url == self.url:
                     continue
+                tried = True
                 try:
                     chunks = [r.data for r in volume_stub(url)
                               .VolumeEcShardRead(
@@ -810,27 +817,53 @@ class VolumeServer:
                     if len(data) == length:
                         return data
                 except grpc.RpcError:
-                    self._forget_ec_locations(vid)
+                    continue
+            if tried:
+                # every known location failed: forget THIS shard's
+                # locations so reads stop redialing a dead node
+                # (reference forgetShardId, store_ec.go:214-219).
+                # Subsequent reads of the shard go straight to
+                # reconstruction; the master is re-asked once the
+                # view's refresh window lapses (7m at >=10 known
+                # shards, 11s once fewer than 10 remain) — the same
+                # trade the reference makes
+                self._forget_ec_shard(vid, shard_id)
             return None
         return remote_reader
 
     def _ec_shard_locations(self, vid: int) -> Dict[int, List[str]]:
         now = time.monotonic()
         cached = self._ec_locations.get(vid)
-        if cached is not None and now - cached[0] < EC_LOCATION_TTL:
-            return cached[1]
-        locs: Dict[int, List[str]] = {}
+        if cached is not None:
+            ts, locs = cached
+            n_known = len(locs)
+            if n_known >= TOTAL_SHARDS:
+                window = EC_REFRESH_FULL_S
+            elif n_known >= DATA_SHARDS:
+                window = EC_REFRESH_PARTIAL_S
+            else:
+                window = EC_REFRESH_SPARSE_S
+            if now - ts < window:
+                return locs
+        locs = dict(cached[1]) if cached is not None else {}
         try:
             resp = master_stub(self.current_master).LookupEcVolume(
                 master_pb2.LookupEcVolumeRequest(volume_id=vid))
+            # merge per shard like the reference (store_ec.go:249-257):
+            # shards absent from the answer keep their last-known urls
             for sl in resp.shard_id_locations:
                 locs[sl.shard_id] = [l.url for l in sl.locations]
         except grpc.RpcError:
             # master unreachable: serve stale cache if any, and don't
-            # poison the cache with an empty map for the next 60s
+            # poison the cache with an empty map until the next window
             return cached[1] if cached is not None else {}
         self._ec_locations[vid] = (now, locs)
         return locs
+
+    def _forget_ec_shard(self, vid: int, shard_id: int) -> None:
+        cached = self._ec_locations.get(vid)
+        if cached is not None:
+            cached[1].pop(shard_id, None)
 
     def _forget_ec_locations(self, vid: int) -> None:
         self._ec_locations.pop(vid, None)

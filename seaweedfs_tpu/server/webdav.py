@@ -16,9 +16,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from http.server import ThreadingHTTPServer
-
-from seaweedfs_tpu.util.http_server import FastHandler
+from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from typing import List, Optional
 
 import grpc
@@ -46,7 +44,7 @@ class WebDavServer:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> None:
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_handler(self))
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,

@@ -15,6 +15,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, Optional, Tuple
 
+from seaweedfs_tpu.util.http_server import TrackingHTTPServer
+
 _DEFAULT_BUCKETS = (
     0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
@@ -237,7 +239,7 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
         def log_message(self, *a):  # quiet
             pass
 
-    srv = ThreadingHTTPServer((ip, port), Handler)
+    srv = TrackingHTTPServer((ip, port), Handler)
     threading.Thread(target=srv.serve_forever, daemon=True,
                      name=f"metrics-{port}").start()
     return srv

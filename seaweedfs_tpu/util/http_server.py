@@ -17,8 +17,10 @@ per-response Date header (cached per second) are replaced.
 
 from __future__ import annotations
 
+import socket
+import threading
 import time
-from http.server import BaseHTTPRequestHandler
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 _MAX_LINE = 65536
 _MAX_HEADERS = 100
@@ -60,6 +62,50 @@ def http_date() -> str:
             f"{('Jan','Feb','Mar','Apr','May','Jun','Jul','Aug','Sep','Oct','Nov','Dec')[t.tm_mon-1]} "
             f"{t.tm_year} {t.tm_hour:02d}:{t.tm_min:02d}:{t.tm_sec:02d} GMT"))
     return _date_cache[1]
+
+
+class TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that force-closes established connections on
+    server_close.
+
+    With keep-alive clients, handler threads park in readline() waiting
+    for the next request; stock server_close only closes the LISTENER,
+    so a stopped server keeps answering on old connections — and once
+    the OS reuses its port for a new server, pooled clients talk to a
+    ghost. Tracking and shutting the accepted sockets makes stop mean
+    stop (Go's http.Server.Close closes active conns the same way)."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 class FastHandler(BaseHTTPRequestHandler):

@@ -129,3 +129,8 @@ class Cluster:
         for vs in self.volume_servers:
             vs.stop()
         self.master.stop()
+        # drop pooled HTTP connections: this cluster's ports may be
+        # reused by the next test's servers, and idle sockets otherwise
+        # accumulate across the whole session
+        from seaweedfs_tpu.util import http_client
+        http_client.close_all()

@@ -14,6 +14,17 @@ force_cpu_platform(8)
 import pytest
 
 
+def pytest_collection_modifyitems(items):
+    """Run the heavy 8-device mesh tests FIRST: they allocate
+    multi-GB XLA buffers and have aborted (bad_alloc-style SIGABRT)
+    when scheduled late in a long suite with hundreds of tests' worth
+    of ambient state; fresh-process placement keeps them deterministic
+    and the rest of the suite unaffected."""
+    heavy = [it for it in items if "test_parallel" in it.nodeid]
+    rest = [it for it in items if "test_parallel" not in it.nodeid]
+    items[:] = heavy + rest
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _close_grpc_channels_at_exit():
     """The gRPC channel cache is process-global; closing it per-cluster

@@ -310,3 +310,422 @@ class FakeAzureServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+# -- mongodb (OP_MSG / BSON) --------------------------------------------------
+
+
+class FakeMongoServer:
+    """Dict backend speaking enough OP_MSG for MongodbStore:
+    createIndexes / update(upsert) / find(+sort/limit) / delete /
+    getMore. Filters: equality, name $gt/$gte, $or with $regex-prefix
+    on directory (exactly what the store issues)."""
+
+    def __init__(self):
+        import struct as _struct
+        from seaweedfs_tpu.filer.stores.mongodb_store import (decode_doc,
+                                                              encode_doc)
+        self.docs: Dict[Tuple[str, str], Dict[str, object]] = {}
+        self.port = free_port_pair()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    header = self.rfile.read(16)
+                    if len(header) < 16:
+                        return
+                    length, req_id, _, opcode = _struct.unpack(
+                        "<iiii", header)
+                    payload = self.rfile.read(length - 16)
+                    if opcode != 2013:
+                        return
+                    cmd, _ = decode_doc(payload, 5)
+                    reply = outer._dispatch(cmd)
+                    body = _struct.pack("<I", 0) + b"\x00" + \
+                        encode_doc(reply)
+                    self.wfile.write(_struct.pack(
+                        "<iiii", 16 + len(body), 1, req_id, 2013) + body)
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    @staticmethod
+    def _matches(doc, q) -> bool:
+        import re
+        for k, cond in q.items():
+            if k == "$or":
+                if not any(FakeMongoServer._matches(doc, sub)
+                           for sub in cond):
+                    return False
+                continue
+            v = doc.get(k)
+            if isinstance(cond, dict):
+                for op, arg in cond.items():
+                    if op == "$gt" and not (v is not None and v > arg):
+                        return False
+                    elif op == "$gte" and not (v is not None
+                                               and v >= arg):
+                        return False
+                    elif op == "$regex" and not (
+                            isinstance(v, str) and re.search(arg, v)):
+                        return False
+            elif v != cond:
+                return False
+        return True
+
+    def _dispatch(self, cmd: dict) -> dict:
+        name = next(iter(cmd))
+        if name == "createIndexes":
+            return {"ok": 1.0}
+        if name == "update":
+            for u in cmd["updates"]:
+                q, update = u["q"], u["u"]["$set"]
+                hits = [k for k, d in self.docs.items()
+                        if self._matches(d, q)]
+                if hits:
+                    for k in hits:
+                        self.docs[k].update(update)
+                elif u.get("upsert"):
+                    d = dict(q)
+                    d.update(update)
+                    self.docs[(d["directory"], d["name"])] = d
+            return {"ok": 1.0}
+        if name == "delete":
+            removed = 0
+            for spec in cmd["deletes"]:
+                hits = [k for k, d in self.docs.items()
+                        if self._matches(d, spec["q"])]
+                if spec.get("limit"):
+                    hits = hits[:spec["limit"]]
+                for k in hits:
+                    del self.docs[k]
+                    removed += 1
+            return {"ok": 1.0, "n": removed}
+        if name == "find":
+            hits = [d for d in self.docs.values()
+                    if self._matches(d, cmd.get("filter", {}))]
+            if "sort" in cmd:
+                key = next(iter(cmd["sort"]))
+                hits.sort(key=lambda d: d.get(key, ""))
+            limit = cmd.get("limit") or len(hits)
+            return {"ok": 1.0, "cursor": {
+                "id": 0, "ns": f"{cmd.get('$db')}.{cmd['find']}",
+                "firstBatch": hits[:limit]}}
+        if name == "getMore":
+            return {"ok": 1.0, "cursor": {"id": 0, "nextBatch": []}}
+        return {"ok": 0.0, "errmsg": f"unknown command {name}"}
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- cassandra (CQL v4) -------------------------------------------------------
+
+
+class FakeCassandraServer:
+    """Partition map speaking enough CQL v4 for CassandraStore: the
+    exact INSERT/SELECT/DELETE statements it issues, with positional
+    values. Clustering order (name) is maintained per partition."""
+
+    def __init__(self, require_auth: bool = False):
+        self.partitions: Dict[bytes, Dict[bytes, bytes]] = {}
+        self.require_auth = require_auth
+        self.port = free_port_pair()
+        import struct as _struct
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    header = self.rfile.read(9)
+                    if len(header) < 9:
+                        return
+                    _v, _f, stream, opcode, length = _struct.unpack(
+                        ">BBhBi", header)
+                    body = self.rfile.read(length)
+                    self._reply_to(stream, opcode, body)
+
+            def _send(self, stream, opcode, body=b""):
+                self.wfile.write(_struct.pack(
+                    ">BBhBi", 0x84, 0, stream, opcode, len(body)) + body)
+
+            def _reply_to(self, stream, opcode, body):
+                if opcode == 0x01:                       # STARTUP
+                    if outer.require_auth:
+                        self._send(stream, 0x03,
+                                   _cql_string("PasswordAuthenticator"))
+                    else:
+                        self._send(stream, 0x02)
+                    return
+                if opcode == 0x0F:                       # AUTH_RESPONSE
+                    self._send(stream, 0x10,
+                               _struct.pack(">i", -1))
+                    return
+                if opcode != 0x07:                       # QUERY only
+                    self._send(stream, 0x00, _struct.pack(">i", 0x000A)
+                               + _cql_string("unsupported opcode"))
+                    return
+                (n,) = _struct.unpack_from(">i", body, 0)
+                cql = body[4:4 + n].decode()
+                pos = 4 + n + 2                          # consistency
+                flags = body[pos]
+                pos += 1
+                values = []
+                if flags & 0x01:
+                    (count,) = _struct.unpack_from(">H", body, pos)
+                    pos += 2
+                    for _ in range(count):
+                        (ln,) = _struct.unpack_from(">i", body, pos)
+                        pos += 4
+                        values.append(body[pos:pos + ln])
+                        pos += ln
+                self._send(stream, 0x08, outer._run(cql, values))
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def _run(self, cql: str, values) -> bytes:
+        import struct as _struct
+        s = cql.strip()
+        if s.startswith("INSERT INTO"):
+            d, name, meta = values[0], values[1], values[2]
+            self.partitions.setdefault(d, {})[name] = meta
+            return _struct.pack(">i", 0x0001)            # void
+        if s.startswith("DELETE FROM"):
+            if "name=?" in s:
+                d, name = values[0], values[1]
+                self.partitions.get(d, {}).pop(name, None)
+            else:
+                self.partitions.pop(values[0], None)
+            return _struct.pack(">i", 0x0001)
+        if s.startswith("SELECT DISTINCT directory"):
+            rows = [[d] for d in self.partitions if self.partitions[d]]
+            return _rows_result(["directory"], rows)
+        if s.startswith("SELECT meta"):
+            d, name = values[0], values[1]
+            meta = self.partitions.get(d, {}).get(name)
+            return _rows_result(["meta"], [] if meta is None
+                                else [[meta]])
+        if s.startswith("SELECT name, meta"):
+            d = values[0]
+            part = self.partitions.get(d, {})
+            names = sorted(part)
+            vi = 1
+            if "name>=?" in s:
+                start = values[vi]
+                vi += 1
+                names = [n for n in names if n >= start]
+            elif "name>?" in s:
+                start = values[vi]
+                vi += 1
+                names = [n for n in names if n > start]
+            if "name<?" in s:
+                hi = values[vi]
+                vi += 1
+                names = [n for n in names if n < hi]
+            limit = len(names)
+            if "LIMIT ?" in s:
+                (limit,) = _struct.unpack(">i", values[vi])
+            return _rows_result(["name", "meta"],
+                                [[n, part[n]] for n in names[:limit]])
+        return _struct.pack(">i", 0x0001)
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _cql_string(s: str) -> bytes:
+    import struct as _struct
+    raw = s.encode()
+    return _struct.pack(">H", len(raw)) + raw
+
+
+def _rows_result(cols, rows) -> bytes:
+    import struct as _struct
+    out = _struct.pack(">i", 0x0002)                     # kind = rows
+    out += _struct.pack(">ii", 0x0001, len(cols))        # global spec
+    out += _cql_string("ks") + _cql_string("filemeta")
+    for c in cols:
+        out += _cql_string(c) + _struct.pack(">H", 0x0003)  # blob
+    out += _struct.pack(">i", len(rows))
+    for row in rows:
+        for cell in row:
+            if cell is None:
+                out += _struct.pack(">i", -1)
+            else:
+                out += _struct.pack(">i", len(cell)) + bytes(cell)
+    return out
+
+
+# -- elasticsearch (REST/JSON) ------------------------------------------------
+
+
+class FakeElasticServer:
+    """Dict-of-indices speaking enough of the ES REST API for
+    ElasticStore: index create, _doc PUT/GET/DELETE, _search with
+    bool/term/prefix/range + sort + size, _delete_by_query,
+    /_cat/indices. Optional basic auth."""
+
+    def __init__(self, username: str = "", password: str = ""):
+        self.indices: Dict[str, Dict[str, dict]] = {}
+        self.port = free_port_pair()
+        expect_auth = None
+        if username:
+            expect_auth = "Basic " + base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, payload, code=200):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _route(self):
+                if expect_auth and \
+                        self.headers.get("Authorization") != expect_auth:
+                    self._json({"error": "unauthorized"}, 401)
+                    return None
+                path = self.path.split("?", 1)[0]
+                return [p for p in path.split("/") if p]
+
+            def do_PUT(self):
+                parts = self._route()
+                if parts is None:
+                    return
+                if len(parts) == 1:                    # create index
+                    created = parts[0] not in outer.indices
+                    outer.indices.setdefault(parts[0], {})
+                    self._json({"acknowledged": True}
+                               if created else {"error": "exists"},
+                               200 if created else 400)
+                    return
+                if len(parts) == 3 and parts[1] == "_doc":
+                    body = self._body()
+                    outer.indices.setdefault(parts[0], {})[parts[2]] = \
+                        body
+                    self._json({"result": "created"}, 201)
+                    return
+                self._json({"error": "bad put"}, 400)
+
+            def do_GET(self):
+                parts = self._route()
+                if parts is None:
+                    return
+                if parts and parts[0] == "_cat":
+                    self._json([{"index": name}
+                                for name in sorted(outer.indices)])
+                    return
+                if len(parts) == 3 and parts[1] == "_doc":
+                    doc = outer.indices.get(parts[0], {}).get(parts[2])
+                    if doc is None:
+                        self._json({"found": False}, 404)
+                    else:
+                        self._json({"found": True, "_source": doc})
+                    return
+                self._json({"error": "bad get"}, 400)
+
+            def do_DELETE(self):
+                parts = self._route()
+                if parts is None:
+                    return
+                if len(parts) == 3 and parts[1] == "_doc":
+                    existed = outer.indices.get(parts[0], {}) \
+                        .pop(parts[2], None) is not None
+                    self._json({"result": "deleted" if existed
+                                else "not_found"},
+                               200 if existed else 404)
+                    return
+                if len(parts) == 1:
+                    outer.indices.pop(parts[0], None)
+                    self._json({"acknowledged": True})
+                    return
+                self._json({"error": "bad delete"}, 400)
+
+            @staticmethod
+            def _matches(doc, query):
+                for clause in query.get("bool", {}).get("must", []):
+                    if not Handler._clause(doc, clause):
+                        return False
+                should = query.get("bool", {}).get("should")
+                if should and not any(Handler._clause(doc, c)
+                                      for c in should):
+                    return False
+                return True
+
+            @staticmethod
+            def _clause(doc, clause):
+                if "term" in clause:
+                    ((f, v),) = clause["term"].items()
+                    return doc.get(f) == v
+                if "prefix" in clause:
+                    ((f, v),) = clause["prefix"].items()
+                    return str(doc.get(f, "")).startswith(v)
+                if "range" in clause:
+                    ((f, cond),) = clause["range"].items()
+                    val = doc.get(f, "")
+                    for op, arg in cond.items():
+                        if op == "gt" and not val > arg:
+                            return False
+                        if op == "gte" and not val >= arg:
+                            return False
+                    return True
+                return True
+
+            def do_POST(self):
+                parts = self._route()
+                if parts is None:
+                    return
+                body = self._body()
+                if len(parts) == 2 and parts[1] == "_search":
+                    docs = [d for d in
+                            outer.indices.get(parts[0], {}).values()
+                            if self._matches(d, body.get("query", {}))]
+                    for spec in reversed(body.get("sort", [])):
+                        ((f, order),) = spec.items()
+                        docs.sort(key=lambda d: d.get(f, ""),
+                                  reverse=order == "desc")
+                    docs = docs[:body.get("size", 10)]
+                    self._json({"hits": {"hits": [
+                        {"_source": d} for d in docs]}})
+                    return
+                if len(parts) == 2 and parts[1] == "_delete_by_query":
+                    idx = outer.indices.get(parts[0], {})
+                    doomed = [k for k, d in idx.items()
+                              if self._matches(d, body.get("query", {}))]
+                    for k in doomed:
+                        del idx[k]
+                    self._json({"deleted": len(doomed)})
+                    return
+                self._json({"error": "bad post"}, 400)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", self.port),
+                                           Handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

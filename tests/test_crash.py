@@ -1,0 +1,290 @@
+"""Fault injection for the crash-ordered paths.
+
+The generate→copy→mount→delete EC spread, the vacuum shadow-file
+commit, the group-commit append, and raft log compaction all promise
+specific invariants when a process dies mid-sequence. These tests kill
+each sequence at its most dangerous point and assert the invariant the
+ordering exists to protect.
+
+Reference orderings: shell/command_ec_encode.go:179-205 (source volume
+survives until every shard is spread), storage/volume_vacuum.go:89-155
+(.cpd/.cpx shadow commit), storage/volume_checking.go:16-66 (torn-tail
+truncation).
+"""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.operation import operations
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.shell import CommandError, Shell
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from tests.cluster_util import Cluster
+
+
+# -- vacuum shadow-commit crashes (library level) -----------------------------
+
+
+def _volume_with_garbage(tmp_path):
+    store = Store([str(tmp_path)])
+    store.add_volume(1)
+    v = store.find_volume(1)
+    keep = {}
+    for i in range(1, 21):
+        data = os.urandom(512) + bytes([i])
+        v.write_needle(Needle(id=i, cookie=5, data=data))
+        if i % 2:
+            keep[i] = data
+        else:
+            v.delete_needle(Needle(id=i, cookie=5))
+    return store, v, keep
+
+
+def _reload(tmp_path):
+    store = Store([str(tmp_path)])
+    return store, store.find_volume(1)
+
+
+def test_crash_before_vacuum_commit_aborts_cleanly(tmp_path):
+    """Die after phase 1 (shadows written) but before commit: reload
+    must drop .cpd+.cpx and serve the original data."""
+    store, v, keep = _volume_with_garbage(tmp_path)
+    state = vacuum_mod.compact(v)
+    assert os.path.exists(state.cpd_path)
+    assert os.path.exists(state.cpx_path)
+    store.close()  # "crash": commit_compact never runs
+
+    store2, v2 = _reload(tmp_path)
+    assert not os.path.exists(state.cpd_path)
+    assert not os.path.exists(state.cpx_path)
+    for i, data in keep.items():
+        got = v2.read_needle(Needle(id=i, cookie=5))
+        assert bytes(got.data) == data
+    store2.close()
+
+
+def test_crash_between_commit_renames_rolls_forward(tmp_path):
+    """Die after .cpd->.dat but before .cpx->.idx: the .dat is already
+    the compacted one, so reload must roll the index forward — without
+    that, the OLD .idx would address needles at pre-compaction offsets
+    in the NEW file."""
+    store, v, keep = _volume_with_garbage(tmp_path)
+    state = vacuum_mod.compact(v)
+
+    real_replace = os.replace
+    calls = []
+
+    def crashing_replace(src, dst):
+        calls.append((src, dst))
+        real_replace(src, dst)
+        if len(calls) == 1:  # after the FIRST rename (.cpd -> .dat)
+            raise OSError("injected crash between renames")
+
+    vacuum_mod.os.replace = crashing_replace
+    try:
+        with pytest.raises(OSError, match="injected"):
+            vacuum_mod.commit_compact(v, state)
+    finally:
+        vacuum_mod.os.replace = real_replace
+    store.close()
+
+    store2, v2 = _reload(tmp_path)
+    assert not os.path.exists(state.cpx_path)  # rolled forward
+    for i, data in keep.items():
+        got = v2.read_needle(Needle(id=i, cookie=5))
+        assert bytes(got.data) == data
+    # the compaction took: deleted needles are physically gone
+    assert os.path.getsize(v2.dat_path) < \
+        sum(len(d) for d in keep.values()) * 3
+    store2.close()
+
+
+def test_torn_tail_truncated_on_reload(tmp_path):
+    """Die mid group-commit batch: bytes appended to the .dat with no
+    published index entry must be truncated at load, and every acked
+    write must survive."""
+    store, v, keep = _volume_with_garbage(tmp_path)
+    dat_path = v.dat_path
+    store.close()
+    good_size = os.path.getsize(dat_path)
+    with open(dat_path, "ab") as f:
+        f.write(os.urandom(1000))  # torn, unacked batch tail
+
+    store2, v2 = _reload(tmp_path)
+    assert os.path.getsize(dat_path) == good_size
+    for i, data in keep.items():
+        assert bytes(v2.read_needle(Needle(id=i, cookie=5)).data) == data
+    # and the volume still accepts writes after repair
+    v2.write_needle(Needle(id=100, cookie=5, data=b"post-crash write"))
+    assert bytes(v2.read_needle(
+        Needle(id=100, cookie=5)).data) == b"post-crash write"
+    store2.close()
+
+
+# -- EC spread crashes (real cluster) -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("crash"), n_volume_servers=3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture()
+def shell(cluster):
+    return Shell(cluster.master.url)
+
+
+def _fill(cluster, collection, n=6):
+    datas = [os.urandom(1024) for _ in range(n)]
+    fids = [cluster.upload(d, collection=collection) for d in datas]
+    vid = parse_fid(fids[0]).volume_id
+    return vid, [(f, d) for f, d in zip(fids, datas)
+                 if parse_fid(f).volume_id == vid]
+
+
+def test_ec_encode_crash_mid_spread_source_survives(cluster, shell):
+    """Kill the spread after shards were generated and partially
+    copied: the source volume must still serve reads (it is deleted
+    only AFTER all 14 shards are spread), and a retry must complete."""
+    from seaweedfs_tpu.shell import command_ec
+
+    vid, blobs = _fill(cluster, "crashec")
+    assert blobs, "need at least one blob on the volume"
+
+    real_spread = command_ec._spread_ec_shards
+    spread_calls = []
+
+    def crashing_spread(env, v, collection, source, plan, out):
+        spread_calls.append(v)
+        raise RuntimeError("injected: target died during shard copy")
+
+    command_ec._spread_ec_shards = crashing_spread
+    try:
+        with pytest.raises(CommandError, match="injected"):
+            shell.run_command(f"ec.encode -volumeId={vid}")
+    finally:
+        command_ec._spread_ec_shards = real_spread
+    assert spread_calls == [vid]
+
+    # invariant: every blob still readable through the public path
+    for fid, data in blobs:
+        assert operations.download(cluster.master.url, fid) == data
+
+    # recovery: a retry finishes the job and reads keep working (now
+    # through the EC path)
+    shell.run_command(f"ec.encode -volumeId={vid}")
+    assert not any(vs.store.has_volume(vid)
+                   for vs in cluster.volume_servers), \
+        "normal volume must be gone after a successful encode"
+    for fid, data in blobs:
+        assert operations.download(cluster.master.url, fid) == data
+
+
+def test_ec_spread_crash_after_copy_keeps_every_shard(cluster, shell):
+    """Kill the source AFTER a target copied+mounted a shard but
+    BEFORE the source unmounted its copy: nothing may be lost; at
+    worst a shard is held twice, and reads still work."""
+    vid, blobs = _fill(cluster, "crashec2")
+
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    real_delete = VolumeServer.VolumeEcShardsDelete
+    fails = []
+
+    def flaky_delete(self, request, context):
+        # first source-side unmount dies (simulated source crash)
+        if not fails:
+            fails.append(request.volume_id)
+            raise RuntimeError("injected: source died before unmount")
+        return real_delete(self, request, context)
+
+    VolumeServer.VolumeEcShardsDelete = flaky_delete
+    try:
+        try:
+            shell.run_command(f"ec.encode -volumeId={vid}")
+        except CommandError:
+            pass  # the injected failure may or may not abort the walk
+    finally:
+        VolumeServer.VolumeEcShardsDelete = real_delete
+
+    # nothing lost: the union of held shards covers all 14
+    held = set()
+    for _, _, dn in shell.env.data_nodes(shell.env.topology()):
+        for e in dn.ec_shard_infos:
+            if e.id == vid:
+                from seaweedfs_tpu.ec.shard_bits import ShardBits
+                held |= set(ShardBits(e.ec_index_bits).shard_ids)
+    if held:  # encode reached the spread phase
+        assert held == set(range(14))
+    # and every blob is still readable regardless
+    for fid, data in blobs:
+        assert operations.download(cluster.master.url, fid) == data
+
+
+# -- raft compaction crash ----------------------------------------------------
+
+
+def test_raft_crash_mid_snapshot_write_recovers(tmp_path):
+    """Die while writing the compaction snapshot: the commit point is
+    the snapshot rename, so a crash before it must leave the old
+    WAL+snapshot pair intact and lose NO committed entry."""
+    from seaweedfs_tpu.server.raft import RaftNode
+
+    class Counter:
+        """Tiny state machine with real snapshot/restore, like the
+        master's sequence state."""
+
+        def __init__(self):
+            self.state = {"count": 0, "last": -1}
+
+        def apply(self, cmd, *a):
+            self.state["count"] += 1
+            self.state["last"] = cmd["n"]
+
+        def snapshot(self):
+            return dict(self.state)
+
+        def restore(self, snap):
+            if snap:
+                self.state = dict(snap)
+
+    sm = Counter()
+    node = RaftNode("127.0.0.1:7001", [], str(tmp_path),
+                    apply=sm.apply, snapshot_fn=sm.snapshot,
+                    restore_fn=sm.restore)
+    node.LOG_CAP = 8
+    for i in range(30):
+        node.propose({"n": i})
+    assert sm.state == {"count": 30, "last": 29}
+
+    real_replace = os.replace
+    import seaweedfs_tpu.server.raft as raft_mod
+
+    def crashing_replace(src, dst):
+        if str(dst).endswith("raft.snap.json"):
+            raise OSError("injected crash during snapshot rename")
+        return real_replace(src, dst)
+
+    raft_mod.os.replace = crashing_replace
+    try:
+        with pytest.raises(OSError, match="injected"):
+            for i in range(30, 60):
+                node.propose({"n": i})
+    finally:
+        raft_mod.os.replace = real_replace
+    committed = dict(sm.state)
+    node.stop()
+
+    sm2 = Counter()
+    node2 = RaftNode("127.0.0.1:7001", [], str(tmp_path),
+                     apply=sm2.apply, snapshot_fn=sm2.snapshot,
+                     restore_fn=sm2.restore)
+    # snapshot restore + WAL replay must reconstruct every committed
+    # mutation, even though the crash interrupted the snapshot rename
+    assert sm2.state == committed
+    node2.stop()

@@ -1,0 +1,143 @@
+"""Tiered EC shard-location freshness + forget-on-failure
+(reference storage/store_ec.go:214-262: 11s/7m/37m refresh windows,
+forgetShardId on read failure)."""
+
+import tempfile
+import time
+from types import SimpleNamespace
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.server import volume as volume_mod
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+class _FakeMasterStub:
+    def __init__(self, shard_ids, url="10.0.0.9:8080"):
+        self.calls = 0
+        self.shard_ids = shard_ids
+        self.url = url
+
+    def LookupEcVolume(self, req):
+        self.calls += 1
+        return SimpleNamespace(shard_id_locations=[
+            SimpleNamespace(shard_id=s,
+                            locations=[SimpleNamespace(url=self.url)])
+            for s in self.shard_ids])
+
+
+class _DeadVolumeStub:
+    def __init__(self):
+        self.calls = 0
+
+    def VolumeEcShardRead(self, req):
+        self.calls += 1
+
+        class _Err(grpc.RpcError):
+            pass
+        raise _Err("connection refused")
+
+
+@pytest.fixture()
+def vs(tmp_path, monkeypatch):
+    server = VolumeServer("127.0.0.1:9333", [str(tmp_path)])
+    yield server, monkeypatch
+    server.store.close()
+
+
+def _patch_master(monkeypatch, stub):
+    monkeypatch.setattr(volume_mod, "master_stub", lambda target: stub)
+
+
+def test_full_view_cached_long(vs):
+    server, monkeypatch = vs
+    stub = _FakeMasterStub(list(range(14)))
+    _patch_master(monkeypatch, stub)
+    locs = server._ec_shard_locations(7)
+    assert len(locs) == 14
+    server._ec_shard_locations(7)
+    server._ec_shard_locations(7)
+    assert stub.calls == 1  # complete view: 37m window, no re-ask
+    # even past the partial window it stays cached
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_PARTIAL_S - 1,
+                               cached)
+    server._ec_shard_locations(7)
+    assert stub.calls == 1
+    # past the full window it refreshes
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_FULL_S - 1,
+                               cached)
+    server._ec_shard_locations(7)
+    assert stub.calls == 2
+
+
+def test_sparse_view_refreshes_after_11s(vs):
+    server, monkeypatch = vs
+    stub = _FakeMasterStub(list(range(6)))  # < DATA_SHARDS known
+    _patch_master(monkeypatch, stub)
+    server._ec_shard_locations(7)
+    server._ec_shard_locations(7)
+    assert stub.calls == 1  # within 11s
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_SPARSE_S - 1,
+                               cached)
+    server._ec_shard_locations(7)
+    assert stub.calls == 2  # sparse view: re-asks after 11s
+
+
+def test_partial_view_uses_middle_window(vs):
+    server, monkeypatch = vs
+    stub = _FakeMasterStub(list(range(12)))  # >= DATA, < TOTAL
+    _patch_master(monkeypatch, stub)
+    server._ec_shard_locations(7)
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_SPARSE_S - 1,
+                               cached)
+    server._ec_shard_locations(7)
+    assert stub.calls == 1  # 11s is NOT enough to expire a partial view
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_PARTIAL_S - 1,
+                               cached)
+    server._ec_shard_locations(7)
+    assert stub.calls == 2
+
+
+def test_dead_location_forgotten_after_first_failure(vs):
+    server, monkeypatch = vs
+    master = _FakeMasterStub(list(range(14)))
+    dead = _DeadVolumeStub()
+    _patch_master(monkeypatch, master)
+    monkeypatch.setattr(volume_mod, "volume_stub", lambda url: dead)
+
+    reader = server._make_remote_reader(7)
+    assert reader(3, 0, 100) is None
+    assert dead.calls == 1
+    # the dead node's shard entry is gone: a second read must NOT dial
+    # it again (it goes straight to reconstruction instead)
+    assert 3 not in server._ec_locations[7][1]
+    assert reader(3, 0, 100) is None
+    assert dead.calls == 1
+    # other shards keep their locations
+    assert 4 in server._ec_locations[7][1]
+
+
+def test_master_outage_serves_stale(vs):
+    server, monkeypatch = vs
+    good = _FakeMasterStub(list(range(14)))
+    _patch_master(monkeypatch, good)
+    server._ec_shard_locations(7)
+
+    class _DownStub:
+        def LookupEcVolume(self, req):
+            class _Err(grpc.RpcError):
+                pass
+            raise _Err("master down")
+
+    _patch_master(monkeypatch, _DownStub())
+    ts, cached = server._ec_locations[7]
+    server._ec_locations[7] = (ts - volume_mod.EC_REFRESH_FULL_S - 1,
+                               cached)
+    locs = server._ec_shard_locations(7)
+    assert len(locs) == 14  # stale view still served during the outage

@@ -1,0 +1,143 @@
+"""Host-side EC pipelining: prove read/compute/write actually overlap.
+
+The encode path's throughput story depends on double buffering — while
+the device computes chunk i's parity, the host stages chunk i+1
+(SURVEY §7; BASELINE.md config 2 notes). A regression to serial
+staging (retire immediately after dispatch) would be invisible to the
+correctness tests, so this file asserts the EVENT ORDER through an
+instrumented fake backend."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import encoder
+from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, TOTAL_SHARDS
+
+
+class _Handle:
+    def __init__(self, log, idx, parity):
+        self.log = log
+        self.idx = idx
+        self.parity = parity
+
+    def result(self):
+        self.log.append(("retire", self.idx))
+        return self.parity
+
+
+class _InstrumentedRS:
+    """encode_async returns a lazy handle; the log records dispatch and
+    retire order so the test can see what was in flight."""
+
+    def __init__(self):
+        self.log = []
+        self.n = 0
+
+    def encode_async(self, data):
+        idx = self.n
+        self.n += 1
+        self.log.append(("dispatch", idx))
+        if data.ndim == 2:
+            parity = np.zeros((TOTAL_SHARDS - DATA_SHARDS,
+                               data.shape[1]), dtype=np.uint8)
+        else:
+            parity = np.zeros((data.shape[0],
+                               TOTAL_SHARDS - DATA_SHARDS,
+                               data.shape[2]), dtype=np.uint8)
+        return _Handle(self.log, idx, parity)
+
+
+class _NullOut:
+    def write(self, b):
+        pass
+
+
+def _run_large_row(n_chunks: int, chunk: int = 4096):
+    rs = _InstrumentedRS()
+    block_size = chunk * n_chunks
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "x.dat")
+        with open(path, "wb") as f:
+            f.write(os.urandom(block_size * DATA_SHARDS))
+        outputs = [_NullOut() for _ in range(TOTAL_SHARDS)]
+        with open(path, "rb") as f:
+            encoder._encode_large_row(rs, f, 0, block_size, outputs,
+                                      chunk)
+    return rs.log
+
+
+def test_pipeline_keeps_one_dispatch_in_flight():
+    log = _run_large_row(n_chunks=4)
+    dispatches = [i for i, ev in enumerate(log) if ev[0] == "dispatch"]
+    retires = {ev[1]: i for i, ev in enumerate(log) if ev[0] == "retire"}
+    assert len(dispatches) == 4 and len(retires) == 4
+    # overlap: chunk i+1 is dispatched BEFORE chunk i's parity retires
+    # (double buffering). Serial staging would retire i first.
+    for i in range(3):
+        assert dispatches[i + 1] < retires[i], (
+            f"chunk {i + 1} dispatched after chunk {i} retired — "
+            f"pipeline degraded to serial staging: {log}")
+
+
+def test_pipeline_bounded_depth():
+    """No more than PIPELINE_DEPTH-1 handles wait between dispatch and
+    retire — unbounded in-flight would hold every chunk's parity in
+    memory at once."""
+    log = _run_large_row(n_chunks=6)
+    in_flight = 0
+    peak = 0
+    for ev, _ in log:
+        if ev == "dispatch":
+            in_flight += 1
+        else:
+            in_flight -= 1
+        peak = max(peak, in_flight)
+    assert peak == encoder.PIPELINE_DEPTH
+    assert in_flight == 0  # drained at the end
+
+
+def test_small_rows_share_pipeline_overlap():
+    rs = _InstrumentedRS()
+    small = 1024
+    n_rows = 8
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "y.dat")
+        with open(path, "wb") as f:
+            f.write(os.urandom(small * DATA_SHARDS * n_rows))
+        outputs = [_NullOut() for _ in range(TOTAL_SHARDS)]
+        with open(path, "rb") as f:
+            # chunk sized to 2 rows per batch -> 4 dispatches
+            encoder._encode_small_rows(
+                rs, f, 0, small, n_rows, outputs,
+                chunk=small * DATA_SHARDS * 2)
+    dispatches = [i for i, ev in enumerate(rs.log) if ev[0] == "dispatch"]
+    retires = {ev[1]: i for i, ev in enumerate(rs.log)
+               if ev[0] == "retire"}
+    assert len(dispatches) == 4
+    for i in range(3):
+        assert dispatches[i + 1] < retires[i], rs.log
+
+
+def test_rebuild_path_overlaps_too():
+    """rebuild_ec_files pipelines reconstruct dispatches the same way
+    (BASELINE.md config 3 round-3 note)."""
+    from seaweedfs_tpu.ops import ReedSolomon
+    # build a tiny real EC volume with the numpy backend
+    with tempfile.TemporaryDirectory() as d:
+        base = os.path.join(d, "1")
+        blob = os.urandom((1 << 20) + 12345)
+        with open(base + ".dat", "wb") as f:
+            f.write(blob)
+        encoder.write_ec_files(base, backend="numpy")
+        os.remove(encoder.shard_file_name(base, 2))
+        os.remove(encoder.shard_file_name(base, 12))
+        rebuilt = encoder.rebuild_ec_files(base, backend="numpy")
+        assert sorted(rebuilt) == [2, 12]
+        # byte-check against a fresh encode
+        with open(encoder.shard_file_name(base, 2), "rb") as f:
+            got = f.read()
+        rs = ReedSolomon(backend="numpy")
+        assert len(got) > 0

@@ -13,11 +13,31 @@ from seaweedfs_tpu.pb import filer_pb2
 
 
 @pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv",
-                        "redis", "etcd"])
+                        "redis", "etcd", "mongodb", "cassandra",
+                        "elastic"])
 def store(request, tmp_path):
     server = None
     if request.param == "memory":
         s = MemoryStore()
+    elif request.param == "elastic":
+        # real ES REST/JSON against the in-process fake
+        from seaweedfs_tpu.filer.stores.elastic_store import ElasticStore
+        from tests.fake_backends import FakeElasticServer
+        server = FakeElasticServer()
+        s = ElasticStore(servers=[f"127.0.0.1:{server.port}"])
+    elif request.param == "mongodb":
+        # real OP_MSG/BSON over a socket against the in-process fake
+        from seaweedfs_tpu.filer.stores.mongodb_store import MongodbStore
+        from tests.fake_backends import FakeMongoServer
+        server = FakeMongoServer()
+        s = MongodbStore(port=server.port)
+    elif request.param == "cassandra":
+        # real CQL v4 frames against the in-process fake
+        from seaweedfs_tpu.filer.stores.cassandra_store import \
+            CassandraStore
+        from tests.fake_backends import FakeCassandraServer
+        server = FakeCassandraServer()
+        s = CassandraStore(port=server.port)
     elif request.param == "weedkv":
         from seaweedfs_tpu.filer import KvFilerStore
         s = KvFilerStore(str(tmp_path / "weedkv"))

@@ -147,13 +147,131 @@ def test_rmdir_refuses_non_empty(wfs):
         wfs.getattr("/w/full")
 
 
+class TestLinksAndXattrs:
+    """Wfs symlink/hardlink/xattr surface (reference filesys/xattr.go,
+    dir_link.go)."""
+
+    def test_symlink_readlink(self, wfs):
+        import stat
+        fh = wfs.create("/ln/real.txt")
+        wfs.write(fh, b"pointed-at", 0)
+        wfs.release(fh)
+        wfs.symlink("/ln/real.txt", "/ln/alias")
+        entry = wfs.getattr("/ln/alias")
+        assert stat.S_ISLNK(entry.attributes.file_mode)
+        assert wfs.readlink("/ln/alias") == "/ln/real.txt"
+        # readlink on a regular file: EINVAL
+        with pytest.raises(FuseError) as ei:
+            wfs.readlink("/ln/real.txt")
+        assert ei.value.errno == 22
+
+    def test_hardlink_shares_content(self, wfs):
+        fh = wfs.create("/hl/a.txt")
+        wfs.write(fh, b"shared bytes", 0)
+        wfs.release(fh)
+        wfs.link("/hl/a.txt", "/hl/b.txt")
+        ea, eb = wfs.getattr("/hl/a.txt"), wfs.getattr("/hl/b.txt")
+        assert ea.hard_link_id and \
+            bytes(ea.hard_link_id) == bytes(eb.hard_link_id)
+        assert ea.hard_link_counter == eb.hard_link_counter == 2
+        fh2 = wfs.open("/hl/b.txt")
+        assert wfs.read(fh2, 0, 100) == b"shared bytes"
+        wfs.release(fh2)
+        # linking a directory: EMLINK
+        wfs.mkdir("/hl/dir")
+        with pytest.raises(FuseError):
+            wfs.link("/hl/dir", "/hl/dir2")
+
+    def test_xattr_lifecycle(self, wfs):
+        fh = wfs.create("/xa/file.txt")
+        wfs.release(fh)
+        p = "/xa/file.txt"
+        wfs.setxattr(p, "user.color", b"teal")
+        wfs.setxattr(p, "user.shape", b"round")
+        assert wfs.getxattr(p, "user.color") == b"teal"
+        assert wfs.listxattr(p) == ["user.color", "user.shape"]
+        # XATTR_CREATE on an existing name: EEXIST
+        with pytest.raises(FuseError) as ei:
+            wfs.setxattr(p, "user.color", b"x", wfs.XATTR_CREATE)
+        assert ei.value.errno == 17
+        # XATTR_REPLACE on a missing name: ENODATA
+        with pytest.raises(FuseError) as ei:
+            wfs.setxattr(p, "user.nope", b"x", wfs.XATTR_REPLACE)
+        assert ei.value.errno == 61
+        wfs.removexattr(p, "user.color")
+        assert wfs.listxattr(p) == ["user.shape"]
+        with pytest.raises(FuseError) as ei:
+            wfs.getxattr(p, "user.color")
+        assert ei.value.errno == 61
+        with pytest.raises(FuseError):
+            wfs.removexattr(p, "user.color")
+
+    def test_hardlink_write_coherence(self, wfs):
+        """Write through one link name, read through the sibling: the
+        meta cache stores hardlinked entries as stubs over shared meta
+        (reference meta_cache wraps FilerStoreWrapper), so siblings
+        never serve stale chunk lists."""
+        fh = wfs.create("/hc/a.txt")
+        wfs.write(fh, b"original", 0)
+        wfs.release(fh)
+        wfs.link("/hc/a.txt", "/hc/b.txt")
+        fh = wfs.open("/hc/a.txt")
+        wfs.write(fh, b"UPDATED!", 0)
+        wfs.release(fh)  # flush through name a
+        fh2 = wfs.open("/hc/b.txt")
+        assert wfs.read(fh2, 0, 100) == b"UPDATED!"
+        wfs.release(fh2)
+
+    def test_own_subscription_echo_is_skipped(self, wfs):
+        """A lagging subscription echo of this mount's OWN mutation must
+        not clobber newer local state (reference wfs.signature +
+        meta_cache_subscribe skip). Deterministic replay of the race
+        that flaked the hardlink coherence test under suite load."""
+        from seaweedfs_tpu.pb import filer_pb2
+        fh = wfs.create("/echo/f.txt")
+        wfs.write(fh, b"new content", 0)
+        wfs.release(fh)
+        fresh = wfs.getattr("/echo/f.txt")
+        # forge the delayed echo: this mount's own signature, stale body
+        stale = filer_pb2.Entry(name="f.txt")
+        rec = filer_pb2.SubscribeMetadataResponse(directory="/echo")
+        rec.event_notification.new_entry.CopyFrom(stale)
+        rec.event_notification.signatures.append(wfs.signature)
+        wfs.meta_cache._apply(rec)
+        assert wfs.getattr("/echo/f.txt").chunks == fresh.chunks
+        # a FOREIGN event (no signature) still applies
+        rec2 = filer_pb2.SubscribeMetadataResponse(directory="/echo")
+        rec2.event_notification.new_entry.CopyFrom(stale)
+        wfs.meta_cache._apply(rec2)
+        assert not wfs.getattr("/echo/f.txt").chunks
+
+    def test_xattrs_survive_hardlink_copy(self, wfs):
+        fh = wfs.create("/xa/linked.txt")
+        wfs.release(fh)
+        wfs.setxattr("/xa/linked.txt", "user.tag", b"v1")
+        wfs.link("/xa/linked.txt", "/xa/linked2.txt")
+        assert wfs.getxattr("/xa/linked2.txt", "user.tag") == b"v1"
+
+    def test_chown_utimens(self, wfs):
+        fh = wfs.create("/at/f.txt")
+        wfs.release(fh)
+        wfs.chown("/at/f.txt", 1234, 0xFFFFFFFF)  # gid: leave as is
+        e = wfs.getattr("/at/f.txt")
+        assert e.attributes.uid == 1234
+        wfs.utimens("/at/f.txt", 1234567890)
+        assert wfs.getattr("/at/f.txt").attributes.mtime == 1234567890
+
+
 # -- real kernel mount through the libfuse ctypes shim ------------------------
 
 
-def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
-    """Mount a real cluster through /dev/fuse and drive it with plain
-    os/file calls. Skipped where libfuse or /dev/fuse is unavailable
-    (the library-level tests above still cover the Wfs logic)."""
+import contextlib
+
+
+@contextlib.contextmanager
+def kernel_mount(tmp_path_factory, tmp_path, name):
+    """Real cluster mounted through /dev/fuse; yields the mountpoint.
+    Skips where libfuse, /dev/fuse, or mount privilege is missing."""
     import os
     import threading
     import time
@@ -163,8 +281,7 @@ def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
 
     if not fuse_shim.available():
         pytest.skip("libfuse / /dev/fuse not available")
-
-    c = Cluster(tmp_path_factory.mktemp("fusemnt"), n_volume_servers=1,
+    c = Cluster(tmp_path_factory.mktemp(name), n_volume_servers=1,
                 with_filer=True)
     wfs = Wfs(c.filer.url)
     mp = str(tmp_path / "mnt")
@@ -179,6 +296,21 @@ def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
         c.stop()
         pytest.skip("FUSE mount did not come up (no mount privilege?)")
     try:
+        yield mp
+    finally:
+        m.unmount()
+        t.join(timeout=5)
+        wfs.stop()
+        c.stop()
+
+
+def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
+    """Mount a real cluster through /dev/fuse and drive it with plain
+    os/file calls. Skipped where libfuse or /dev/fuse is unavailable
+    (the library-level tests above still cover the Wfs logic)."""
+    import os
+
+    with kernel_mount(tmp_path_factory, tmp_path, "fusemnt") as mp:
         # create + read back
         with open(f"{mp}/hello.txt", "w") as f:
             f.write("hello from fuse")
@@ -205,8 +337,51 @@ def test_fuse_mount_end_to_end(tmp_path_factory, tmp_path):
         os.remove(f"{mp}/sub/hi.txt")
         os.rmdir(f"{mp}/sub")
         assert os.listdir(mp) == []
-    finally:
-        m.unmount()
-        t.join(timeout=5)
-        wfs.stop()
-        c.stop()
+
+
+def test_fuse_mount_links_xattrs(tmp_path_factory, tmp_path):
+    """Kernel-level symlink / hardlink / xattr / utime through
+    /dev/fuse (reference filesys/xattr.go, dir_link.go). Skipped where
+    FUSE is unavailable; the library-level TestLinksAndXattrs still
+    covers the Wfs logic."""
+    import os
+    import stat
+
+    with kernel_mount(tmp_path_factory, tmp_path, "fuselnk") as mp:
+        with open(f"{mp}/orig.txt", "w") as f:
+            f.write("link target content")
+
+        # symlink + readlink + lstat
+        os.symlink(f"{mp}/orig.txt", f"{mp}/sym")
+        assert os.readlink(f"{mp}/sym") == f"{mp}/orig.txt"
+        assert stat.S_ISLNK(os.lstat(f"{mp}/sym").st_mode)
+        with open(f"{mp}/sym") as f:  # kernel follows the link
+            assert f.read() == "link target content"
+
+        # hard link: same content, nlink=2 on both
+        os.link(f"{mp}/orig.txt", f"{mp}/hard")
+        assert os.stat(f"{mp}/hard").st_nlink == 2
+        assert os.stat(f"{mp}/orig.txt").st_nlink == 2
+        with open(f"{mp}/hard") as f:
+            assert f.read() == "link target content"
+
+        # write through one link name, read through the other: the
+        # meta cache must resolve both names to the shared inode
+        with open(f"{mp}/hard", "w") as f:
+            f.write("rewritten via hard")
+        with open(f"{mp}/orig.txt") as f:
+            assert f.read() == "rewritten via hard"
+
+        # xattrs through the kernel syscall surface
+        os.setxattr(f"{mp}/orig.txt", "user.k", b"v1")
+        assert os.getxattr(f"{mp}/orig.txt", "user.k") == b"v1"
+        assert "user.k" in os.listxattr(f"{mp}/orig.txt")
+        os.setxattr(f"{mp}/orig.txt", "user.k", b"v2",
+                    os.XATTR_REPLACE)
+        assert os.getxattr(f"{mp}/orig.txt", "user.k") == b"v2"
+        os.removexattr(f"{mp}/orig.txt", "user.k")
+        assert "user.k" not in os.listxattr(f"{mp}/orig.txt")
+
+        # utime persists an explicit mtime
+        os.utime(f"{mp}/orig.txt", (1500000000, 1500000000))
+        assert os.stat(f"{mp}/orig.txt").st_mtime == 1500000000

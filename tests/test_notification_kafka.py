@@ -41,10 +41,20 @@ class _FakeBroker:
 
     def stop(self):
         self._stop = True
+        # closing a listening socket does NOT wake a thread blocked in
+        # accept() on Linux; poke it so the serve thread actually exits
+        # instead of leaking one parked thread per broker
+        try:
+            with socket.create_connection(("127.0.0.1", self.port),
+                                          timeout=1):
+                pass
+        except OSError:
+            pass
         try:
             self.server.close()
         except OSError:
             pass
+        self.thread.join(timeout=2)
 
     # -- protocol plumbing ----------------------------------------------------
 

@@ -496,6 +496,13 @@ def test_volume_move_preserves_readonly(cluster, shell):
                       f"-source={src} -target={dst}")
     dst_vs = next(vs for vs in cluster.volume_servers if vs.url == dst)
     assert dst_vs.store.find_volume(vid).read_only
+    # under full-suite load the heartbeat delta that tells the master
+    # about the moved copy can lag the VolumeDelete on src; reading
+    # before the master catches up sees "no locations" (30s: the 5s
+    # pulse can slip several periods when the single core is saturated)
+    cluster.wait_for(
+        lambda: operations.lookup(cluster.master.url, vid) == [dst],
+        timeout=30, what="master sees the move")
     assert operations.download(cluster.master.url, fid) == b"sealed blob"
 
 
