@@ -1,0 +1,125 @@
+"""Server-side load profile of the small-file data plane (BASELINE).
+
+Spawns the master and volume server as real CLI subprocesses with their
+`-cpuprofile` flag (Python 3.12's sys.monitoring-based cProfile captures
+every thread in the process; the grace hooks dump pstats on SIGTERM),
+then drives the config-7 write/read load from this (unprofiled) process
+and prints each server's top functions by internal time. This answers
+the question VERDICT r4 asked about the remaining write-plane gap:
+where do the server's cycles actually go per request — interpreter work
+we can shave, or kernel/socket time that is the floor?
+
+Usage: python bench_profile.py [write|read|both] [n]
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pathlib
+import pstats
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(*args: str) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=REPO, env=env)
+
+
+def _wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError(f"server at {url} never came up")
+
+
+def _report(name: str, prof_path: str, top: int = 25) -> None:
+    if not os.path.exists(prof_path):
+        print(f"[no profile dumped for {name}]")
+        return
+    out = io.StringIO()
+    st = pstats.Stats(prof_path, stream=out)
+    st.strip_dirs()
+    print(f"\n===== {name} — top {top} by internal time =====")
+    st.sort_stats("tottime").print_stats(top)
+    print(out.getvalue())
+    out.truncate(0)
+    out.seek(0)
+    print(f"===== {name} — top {top} by cumulative =====")
+    st.sort_stats("cumulative").print_stats(top)
+    print(out.getvalue())
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 20_000
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="prof-"))
+    mport, vport = _free_port(), _free_port()
+    mprof, vprof = str(tmp / "master.prof"), str(tmp / "volume.prof")
+    procs = []
+    try:
+        procs.append(_spawn(
+            "master", "-port", str(mport), "-mdir", str(tmp / "m"),
+            "-cpuprofile", mprof))
+        _wait_http(f"http://127.0.0.1:{mport}/cluster/status")
+        procs.append(_spawn(
+            "volume", "-port", str(vport), "-dir", str(tmp / "v"),
+            "-mserver", f"127.0.0.1:{mport}", "-pulseSeconds", "0.3",
+            "-cpuprofile", vprof))
+        _wait_http(f"http://127.0.0.1:{vport}/status")
+        time.sleep(1.0)  # let the first heartbeat register the volumes
+
+        from seaweedfs_tpu.command.benchmark import \
+            run_benchmark_programmatic
+        r = run_benchmark_programmatic(
+            f"127.0.0.1:{mport}", n=n, concurrency=16, size=1024,
+            do_read=(which in ("read", "both")), out=io.StringIO())
+        for phase in ("write", "read"):
+            if phase in r and r.get(f"{phase}_seconds"):
+                st = r[phase]
+                secs = r[f"{phase}_seconds"]
+                print(f"{phase}: {st.completed / secs:.0f} req/s "
+                      f"({st.completed} ok, {st.failed} failed, "
+                      f"{secs:.1f}s)")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        _report("volume server", vprof)
+        _report("master server", mprof)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

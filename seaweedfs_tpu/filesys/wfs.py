@@ -370,7 +370,8 @@ class Wfs:
         for k, v in e2.extended.items():
             ne.extended[k] = v
         self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=nd, entry=ne))
+            directory=nd, entry=ne,
+            signatures=[self.signature]))
         self.meta_cache.insert(nd, ne)
 
     # -- xattrs (reference filesys/xattr.go) ----------------------------------

@@ -82,25 +82,69 @@ class ChunkedFileReader:
     each chunk's fid through the master and issuing (ranged) GETs over
     the pooled data-plane client."""
 
+    # location cache window: long enough that a 100-chunk GET does not
+    # put the master on the data path, short enough that a moved volume
+    # is re-resolved without reopening the reader
+    LOCATION_TTL_S = 600.0
+
     def __init__(self, chunks: List[ChunkInfo], master_url: str):
         self.chunks = sorted(chunks, key=lambda c: c.offset)
         self.master_url = master_url
         self.total_size = sum(c.size for c in self.chunks)
-        self._vol_urls: dict = {}  # volume id -> server url, memoized
+        self._vol_urls: dict = {}  # volume id -> (monotonic ts, [urls])
 
-    def _chunk_url(self, fid: str) -> str:
+    def _locations(self, fid: str, vid: int) -> List[str]:
+        import time
         from seaweedfs_tpu.operation import operations
+        now = time.monotonic()
+        cached = self._vol_urls.get(vid)
+        if cached is not None and now - cached[0] < self.LOCATION_TTL_S:
+            return cached[1]
+        urls = operations.lookup(self.master_url, vid)
+        if not urls:
+            raise RuntimeError(f"no locations for chunk {fid}")
+        self._vol_urls[vid] = (now, urls)
+        return urls
+
+    def _fetch_chunk(self, fid: str, headers: dict) -> "http_client.Response":
+        """GET one chunk, failing over across the volume's replicas and
+        — when every cached location fails — forgetting the cache entry
+        and re-asking the master once, so one moved/dead volume server
+        does not fail every subsequent read from this reader (reference
+        looks each chunk up fresh, chunked_file.go:176; our EC plane
+        makes the same forget-on-failure trade, server/volume.py)."""
         from seaweedfs_tpu.operation.file_id import parse_fid
         vid = parse_fid(fid).volume_id
-        url = self._vol_urls.get(vid)
-        if url is None:
-            # chunks of one file usually share few volumes; memoize so a
-            # 100-chunk GET does not put the master on the data path
-            urls = operations.lookup(self.master_url, vid)
-            if not urls:
-                raise RuntimeError(f"no locations for chunk {fid}")
-            url = self._vol_urls[vid] = urls[0]
-        return f"{url}/{fid}"
+        # _StaleConnection is http_client's connection-level failure
+        # (clean close / RST from a draining server) — exactly the case
+        # failover exists for, so it must be caught alongside OSError
+        conn_errors = (OSError, http_client._StaleConnection)
+        last_err: Exception = RuntimeError(f"no locations for chunk {fid}")
+        for attempt in range(2):
+            try:
+                urls = self._locations(fid, vid)
+            except (RuntimeError, *conn_errors) as e:
+                last_err = e
+                break
+            for url in urls:
+                try:
+                    r = http_client.request("GET", f"{url}/{fid}",
+                                            headers=headers, timeout=60.0)
+                except conn_errors as e:
+                    last_err = e
+                    continue
+                if r.status in (200, 206):
+                    return r
+                if r.status < 500:
+                    # a definitive per-needle answer (404 deleted, 416
+                    # bad range, ...) is not a topology failure: no
+                    # replica retry storm, no master re-lookup
+                    raise RuntimeError(f"chunk {fid}: http {r.status}")
+                last_err = RuntimeError(f"chunk {fid}: http {r.status}")
+            # every known location failed: drop the memo and re-ask the
+            # master once before giving up
+            self._vol_urls.pop(vid, None)
+        raise last_err
 
     def stream(self, offset: int = 0,
                length: Optional[int] = None) -> Iterator[bytes]:
@@ -114,22 +158,23 @@ class ChunkedFileReader:
                 continue
             start = max(0, offset - c.offset)
             want = min(c.size - start, remaining)
-            url = self._chunk_url(c.fid)
             headers = {}
             if start or want < c.size:
                 headers["Range"] = f"bytes={start}-{start + want - 1}"
-            r = http_client.request("GET", url, headers=headers,
-                                    timeout=60.0)
-            if r.status not in (200, 206):
-                raise RuntimeError(
-                    f"chunk {c.fid}: http {r.status}")
+            r = self._fetch_chunk(c.fid, headers)
             data = r.body
             if r.status == 200 and (start or want < len(data)):
                 # server ignored the range (e.g. compressed chunk)
                 data = data[start:start + want]
+            if len(data) != want:
+                # manifest size disagreeing with the stored needle must
+                # surface loudly, not as misaligned bytes under an
+                # already-sent Content-Length
+                raise RuntimeError(
+                    f"chunk {c.fid}: short read {len(data)} != {want}")
             yield data
-            remaining -= len(data)
-            offset += len(data)
+            remaining -= want
+            offset += want
 
     def read_all(self) -> bytes:
         return b"".join(self.stream())

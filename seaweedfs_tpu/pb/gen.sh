@@ -6,4 +6,4 @@
 # seaweedfs_tpu/pb/__init__.py instead of *_pb2_grpc.py codegen.
 set -e
 cd "$(dirname "$0")"
-protoc --proto_path=proto --python_out=. proto/master.proto proto/volume_server.proto proto/filer.proto proto/messaging.proto proto/raft.proto
+protoc --proto_path=proto --python_out=. proto/master.proto proto/volume_server.proto proto/filer.proto proto/messaging.proto proto/raft.proto proto/iam.proto proto/hbase.proto

@@ -380,15 +380,24 @@ def iam_from_dict(cfg: dict) -> Iam:
     """Build an Iam from the s3.configure JSON document
     ({"identities": [{"name", "credentials": [{"accessKey",
     "secretKey"}], "actions": [...]}]}) — the wire format the shell
-    stores at /etc/iam/identity.json (reference
-    iam_pb.S3ApiConfiguration)."""
+    stores at /etc/iam/identity.json. The document is validated
+    through the generated iam_pb2.S3ApiConfiguration (reference
+    weed/pb/iam.proto:17-31); protobuf JSON mapping camelCases the
+    field names, which IS the wire document's casing."""
+    from google.protobuf import json_format
+
+    from seaweedfs_tpu.pb import iam_pb2
+    try:
+        conf = json_format.ParseDict(cfg, iam_pb2.S3ApiConfiguration(),
+                                     ignore_unknown_fields=True)
+    except json_format.ParseError as e:
+        raise ValueError(f"bad s3 identity document: {e}")
     idents = []
-    for ident in cfg.get("identities", []) or []:
-        creds = [Credential(c.get("accessKey", ""), c.get("secretKey", ""))
-                 for c in ident.get("credentials", [])]
-        idents.append(Identity(name=ident.get("name", ""),
-                               credentials=creds,
-                               actions=list(ident.get("actions", []))))
+    for ident in conf.identities:
+        creds = [Credential(c.access_key, c.secret_key)
+                 for c in ident.credentials]
+        idents.append(Identity(name=ident.name, credentials=creds,
+                               actions=list(ident.actions)))
     return Iam(idents)
 
 

@@ -96,6 +96,19 @@ def make_filer_store(store: str, meta_dir: Optional[str],
                               keyspace=opts.get("keyspace", "seaweedfs"),
                               username=opts.get("username", ""),
                               password=opts.get("password", ""))
+    if store == "hbase":
+        from seaweedfs_tpu.filer.stores.hbase_store import HBaseStore
+        # reference config key is "zkquorum"; this client dials the
+        # region server directly (no ZK walk — hbase_store.py header)
+        addr = opts.get("zkquorum", opts.get("address", "localhost:16020"))
+        if isinstance(addr, list):
+            addr = addr[0]
+        # quorum strings are comma-separated ("zk1:2181,zk2:2181");
+        # this client dials one endpoint, so take the first
+        host, _, port = str(addr).split(",")[0].partition(":")
+        return HBaseStore(host=host or "localhost",
+                          port=int(port or 16020),
+                          table=opts.get("table", "seaweedfs"))
     if store == "mysql":
         from seaweedfs_tpu.filer.stores.abstract_sql import MysqlStore
         return MysqlStore(
@@ -114,8 +127,8 @@ def make_filer_store(store: str, meta_dir: Optional[str],
             database=opts.get("database", "seaweedfs"))
     raise ValueError(
         f"unknown filer store {store!r} (memory | sqlite | weedkv | "
-        "redis | etcd | mongodb | cassandra | elastic7 | mysql | "
-        "postgres)")
+        "redis | etcd | mongodb | cassandra | elastic7 | hbase | "
+        "mysql | postgres)")
 
 
 def _advance_and_filter(events, prefix: str, since: int):

@@ -20,7 +20,7 @@ import time
 import grpc
 from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from typing import Dict, List, Optional, Set
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs
 
 from seaweedfs_tpu import rpc
 from seaweedfs_tpu.util import wlog
@@ -752,12 +752,8 @@ def _make_http_handler(ms: MasterServer):
             self.wfile.write(blob)
 
         def _json(self, payload: dict, code: int = 200) -> None:
-            body = json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self.fast_reply(code, json.dumps(payload).encode(),
+                            ctype="application/json")
 
         def _proxy_to_leader(self) -> bool:
             """Forward this request to the raft leader (reference
@@ -790,29 +786,29 @@ def _make_http_handler(ms: MasterServer):
             return True
 
         def do_GET(self):
-            u = urlparse(self.path)
-            params = parse_qs(u.query)
-            if u.path != "/cluster/status" and self._proxy_to_leader():
+            upath, sep, query = self.path.partition("?")
+            params = parse_qs(query) if sep else {}
+            if upath != "/cluster/status" and self._proxy_to_leader():
                 return
-            if u.path == "/dir/assign":
+            if upath == "/dir/assign":
                 self._json(ms.http_assign(params))
-            elif u.path == "/dir/lookup":
+            elif upath == "/dir/lookup":
                 self._json(ms.http_lookup(params))
-            elif u.path == "/dir/status":
+            elif upath == "/dir/status":
                 self._json({"Topology": ms.topo.to_map(),
                             "Version": "seaweedfs-tpu"})
-            elif u.path == "/vol/grow":
+            elif upath == "/vol/grow":
                 self._json(ms.http_grow(params))
-            elif u.path == "/vol/vacuum":
+            elif upath == "/vol/vacuum":
                 t = params.get("garbageThreshold", [None])[0]
                 vids = ms.vacuum(float(t) if t else None)
                 self._json({"compacted": vids})
-            elif u.path == "/cluster/status":
+            elif upath == "/cluster/status":
                 self._json(ms.http_cluster_status())
-            elif u.path in ("/", "/ui"):
+            elif upath in ("/", "/ui"):
                 self._html(_master_ui(ms))
             else:
-                self._json({"error": f"unknown path {u.path}"}, code=404)
+                self._json({"error": f"unknown path {upath}"}, code=404)
 
         do_POST = do_GET
 

@@ -18,7 +18,7 @@ import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Tuple
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs
 
 import grpc
 
@@ -989,36 +989,34 @@ def _make_http_handler(vs: VolumeServer):
 
         def _reply(self, code: int, body: bytes = b"",
                    headers: Optional[dict] = None) -> None:
-            self.send_response(code)
-            for k, v in (headers or {}).items():
-                self.send_header(k, v)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            if self.command != "HEAD":
-                self.wfile.write(body)
+            self.fast_reply(code, body, headers)
 
         def _json(self, payload: dict, code: int = 200) -> None:
-            self._reply(code, json.dumps(payload).encode(),
-                        {"Content-Type": "application/json"})
+            self.fast_reply(code, json.dumps(payload).encode(),
+                            ctype="application/json")
 
         def _body(self) -> bytes:
-            length = int(self.headers.get("Content-Length") or 0)
+            length = int(self.headers.get("content-length") or 0)
             return self.rfile.read(length) if length else b""
 
         def _parse_path(self):
-            """/<vid>,<key_hex><cookie_hex> with optional leading dirs."""
-            u = urlparse(self.path)
-            fid = u.path.lstrip("/")
-            return parse_fid(fid), parse_qs(u.query)
+            """/<vid>,<key_hex><cookie_hex> with optional leading dirs.
+
+            Manual "?" split instead of urlparse: the data plane never
+            carries params/fragments, and urlparse + parse_qs on every
+            GET is measurable at small-file rates."""
+            path, sep, query = self.path.partition("?")
+            return parse_fid(path.lstrip("/")), \
+                (parse_qs(query) if sep else {})
 
         # -- read -------------------------------------------------------------
 
         def do_GET(self):
-            u = urlparse(self.path)
-            if u.path == "/status":
+            upath = self.path.partition("?")[0]
+            if upath == "/status":
                 self._json(self.server_status())
                 return
-            if u.path in ("/ui", "/ui/"):
+            if upath in ("/ui", "/ui/"):
                 import html as _html
                 st = self.server_status()
                 rows = "".join(
@@ -1118,12 +1116,14 @@ def _make_http_handler(vs: VolumeServer):
                     "application/octet-stream"):
                 headers["Content-Type"] = cm.mime
             status, start, length = 200, 0, total
-            rng = self.headers.get("Range")
+            rng = self.headers.get("range")
             if rng and rng.startswith("bytes="):
                 try:
                     start, end = parse_byte_range(rng, total)
                 except ValueError:
-                    self._reply(416)
+                    # RFC 7233 §4.4: 416 carries the representation size
+                    self._reply(416, headers={
+                        "Content-Range": f"bytes */{total}"})
                     return True
                 status = 206
                 length = end - start + 1
@@ -1152,7 +1152,7 @@ def _make_http_handler(vs: VolumeServer):
         def _send_needle(self, got: Needle,
                          params: Optional[dict] = None) -> None:
             etag = f'"{got.etag}"'
-            if self.headers.get("If-None-Match") == etag:
+            if self.headers.get("if-none-match") == etag:
                 self._reply(304)
                 return
             data = got.data
@@ -1168,7 +1168,7 @@ def _make_http_handler(vs: VolumeServer):
                 ("width" in params or "height" in params)
             if got.is_compressed:
                 if not want_resize and "gzip" in (
-                        self.headers.get("Accept-Encoding") or ""):
+                        self.headers.get("accept-encoding") or ""):
                     headers["Content-Encoding"] = "gzip"
                 else:
                     data = gzip.decompress(data)
@@ -1185,12 +1185,14 @@ def _make_http_handler(vs: VolumeServer):
                 data, _, _ = resized(
                     data, mime, width=width, height=height,
                     mode=params.get("mode", [""])[0])
-            rng = self.headers.get("Range")
+            rng = self.headers.get("range")
             if rng and rng.startswith("bytes=") and not got.is_compressed:
                 try:
                     start, end = parse_byte_range(rng, len(data))
                 except ValueError:
-                    self._reply(416)
+                    # RFC 7233 §4.4: 416 carries the representation size
+                    self._reply(416, headers={
+                        "Content-Range": f"bytes */{len(data)}"})
                     return
                 headers["Content-Range"] = \
                     f"bytes {start}-{end}/{len(data)}"
@@ -1201,22 +1203,23 @@ def _make_http_handler(vs: VolumeServer):
         # -- write ------------------------------------------------------------
 
         def do_POST(self):
-            u = urlparse(self.path)
-            params = parse_qs(u.query)
-            if u.path == "/admin/replicate":
-                self._handle_replicate(params)
-                return
-            if u.path == "/admin/replicate_delete":
-                self._handle_replicate_delete(params)
-                return
+            upath, sep, query = self.path.partition("?")
+            if upath.startswith("/admin/"):
+                params = parse_qs(query) if sep else {}
+                if upath == "/admin/replicate":
+                    self._handle_replicate(params)
+                    return
+                if upath == "/admin/replicate_delete":
+                    self._handle_replicate_delete(params)
+                    return
             try:
                 f, params = self._parse_path()
             except ValueError as e:
                 self._json({"error": str(e)}, code=400)
                 return
             body = self._body()
-            ctype = self.headers.get("Content-Type") or ""
-            encoding = self.headers.get("Content-Encoding") or ""
+            ctype = self.headers.get("content-type") or ""
+            encoding = self.headers.get("content-encoding") or ""
             filename, mime, data = "", ctype, body
             if ctype.startswith("multipart/form-data"):
                 try:
@@ -1331,15 +1334,18 @@ def _make_http_handler(vs: VolumeServer):
     def _instrument(methname):
         orig = getattr(Handler, methname)
         verb = methname[3:].lower()
+        # resolve the labeled children once — labels() takes a lock per
+        # call, measurable at data-plane request rates
+        counter = RequestCounter.labels("volumeServer", verb)
+        histogram = RequestHistogram.labels("volumeServer", verb)
 
         def wrapped(self):
             t0 = time.perf_counter()
             try:
                 orig(self)
             finally:
-                RequestCounter.labels("volumeServer", verb).inc()
-                RequestHistogram.labels("volumeServer", verb).observe(
-                    time.perf_counter() - t0)
+                counter.inc()
+                histogram.observe(time.perf_counter() - t0)
         return wrapped
 
     for _m in ("do_GET", "do_HEAD", "do_POST", "do_DELETE"):

@@ -27,7 +27,7 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from seaweedfs_tpu.util.http_server import HeaderDict
+from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
 
 _pool_lock = threading.Lock()
 _pool: Dict[str, List["_Conn"]] = {}
@@ -40,8 +40,17 @@ class _Conn:
 
     def __init__(self, netloc: str, timeout: float):
         self.netloc = netloc
-        host, _, port = netloc.rpartition(":")
-        self.sock = socket.create_connection((host, int(port)),
+        if netloc.startswith("["):  # [v6-literal]:port or bare [v6-literal]
+            bracket = netloc.find("]")
+            host = netloc[1:bracket]
+            rest = netloc[bracket + 1:]
+            port = int(rest[1:]) if rest.startswith(":") else 80
+        elif ":" in netloc:
+            host, _, port_s = netloc.rpartition(":")
+            port = int(port_s)
+        else:
+            host, port = netloc, 80
+        self.sock = socket.create_connection((host, port),
                                              timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb", buffering=65536)
@@ -195,17 +204,11 @@ def _roundtrip(conn: "_Conn", netloc: str, method: str, path: str,
         raise _StaleConnection(f"bad proto {line!r}")
 
     hdrs = HeaderDict()
-    while True:
-        line = rfile.readline(_MAX_LINE)
-        if line in (b"\r\n", b"\n", b""):
-            break
-        colon = line.find(b":")
-        if colon <= 0:
-            continue
-        key = line[:colon].decode("latin-1").strip().lower()
-        if key not in hdrs:  # first value wins, like the server parser
-            dict.__setitem__(hdrs, key,
-                             line[colon + 1:].decode("latin-1").strip())
+    # same parser as FastHandler.parse_request (first value wins);
+    # shared so client and server header handling stay in lockstep
+    err = parse_header_block(rfile, hdrs)
+    if err is not None:
+        raise _StaleConnection(f"bad header block ({err})")
 
     keep = proto != b"HTTP/1.0"
     conn_hdr = hdrs.get("connection", "").lower()

@@ -729,3 +729,143 @@ class FakeElasticServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+# -- hbase (region-server RPC) ------------------------------------------------
+
+
+class FakeHBaseServer:
+    """Sorted-dict region server speaking the protobuf-framed HBase RPC
+    the HBaseClient issues: preamble + ConnectionHeader, then
+    Get / Mutate(PUT, DELETE) / Scan with scanner sessions. Cells are
+    returned inside protobuf Results (no cell blocks), matching the
+    codec-less ConnectionHeader the client sends. Rows live per column
+    family; scans walk key order from start_row to table end, batched
+    by number_of_rows with more_results set accordingly."""
+
+    def __init__(self):
+        import struct as _struct
+
+        from seaweedfs_tpu.filer.stores.hbase_store import (PREAMBLE,
+                                                            _delimited,
+                                                            _read_varint)
+        from seaweedfs_tpu.pb import hbase_pb2
+        self.rows: Dict[bytes, Dict[bytes, bytes]] = {}  # family -> {row: v}
+        self.scanners: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        self._next_scanner = [1]
+        self.port = free_port_pair()
+        self.calls: List[str] = []  # method names, for assertions
+        outer = self
+        lock = threading.Lock()
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                preamble = self.rfile.read(6)
+                if preamble != PREAMBLE:
+                    return
+                (hlen,) = _struct.unpack(">I", self.rfile.read(4))
+                hello = hbase_pb2.ConnectionHeader()
+                hello.ParseFromString(self.rfile.read(hlen))
+                if hello.service_name != "ClientService":
+                    return
+                while True:
+                    raw = self.rfile.read(4)
+                    if len(raw) < 4:
+                        return
+                    (total,) = _struct.unpack(">I", raw)
+                    frame = self.rfile.read(total)
+                    if len(frame) < total:
+                        return
+                    n, pos = _read_varint(frame, 0)
+                    header = hbase_pb2.RequestHeader()
+                    header.ParseFromString(frame[pos:pos + n])
+                    pos += n
+                    n, pos = _read_varint(frame, pos)
+                    body = frame[pos:pos + n]
+                    with lock:
+                        outer.calls.append(header.method_name)
+                        resp, exc = outer._dispatch(header.method_name,
+                                                    body)
+                    rh = hbase_pb2.ResponseHeader(call_id=header.call_id)
+                    if exc is not None:
+                        rh.exception.exception_class_name = exc
+                        payload = _delimited(rh)
+                    else:
+                        payload = _delimited(rh) + _delimited(resp)
+                    self.wfile.write(
+                        _struct.pack(">I", len(payload)) + payload)
+
+        self._server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", self.port), Handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def _family(self, name: bytes) -> Dict[bytes, bytes]:
+        return self.rows.setdefault(bytes(name), {})
+
+    def _dispatch(self, method: str, body: bytes):
+        from seaweedfs_tpu.pb import hbase_pb2
+        if method == "Get":
+            req = hbase_pb2.GetRequest()
+            req.ParseFromString(body)
+            fam = req.get.column[0].family
+            result = hbase_pb2.Result()
+            value = self._family(fam).get(req.get.row)
+            if value is not None:
+                result.cell.add(row=req.get.row, family=fam,
+                                qualifier=b"a",
+                                cell_type=hbase_pb2.PUT, value=value)
+            return hbase_pb2.GetResponse(result=result), None
+        if method == "Mutate":
+            req = hbase_pb2.MutateRequest()
+            req.ParseFromString(body)
+            m = req.mutation
+            fam = m.column_value[0].family
+            if m.mutate_type == hbase_pb2.MutationProto.PUT:
+                qv = m.column_value[0].qualifier_value[0]
+                self._family(fam)[m.row] = qv.value
+            elif m.mutate_type == hbase_pb2.MutationProto.DELETE:
+                self._family(fam).pop(m.row, None)
+            else:
+                return None, "org.apache.hadoop.hbase." \
+                    "DoNotRetryIOException"
+            return hbase_pb2.MutateResponse(processed=True), None
+        if method == "Scan":
+            req = hbase_pb2.ScanRequest()
+            req.ParseFromString(body)
+            if req.close_scanner:
+                self.scanners.pop(req.scanner_id, None)
+                return hbase_pb2.ScanResponse(more_results=False), None
+            if req.HasField("scan"):
+                fam = req.scan.column[0].family
+                start = req.scan.start_row
+                stop = req.scan.stop_row
+                pending = sorted(
+                    (row, v) for row, v in self._family(fam).items()
+                    if row >= start and (not stop or row < stop))
+                sid = self._next_scanner[0]
+                self._next_scanner[0] += 1
+                self.scanners[sid] = pending
+            else:
+                sid = req.scanner_id
+                pending = self.scanners.get(sid)
+                if pending is None:
+                    return None, "org.apache.hadoop.hbase." \
+                        "UnknownScannerException"
+            batch = req.number_of_rows or 64
+            out, rest = pending[:batch], pending[batch:]
+            self.scanners[sid] = rest
+            resp = hbase_pb2.ScanResponse(scanner_id=sid,
+                                          more_results=bool(rest))
+            for row, value in out:
+                r = resp.results.add()
+                r.cell.add(row=row, family=b"meta", qualifier=b"a",
+                           cell_type=hbase_pb2.PUT, value=value)
+                resp.cells_per_result.append(1)
+            return resp, None
+        return None, "org.apache.hadoop.hbase.UnknownMethodException"
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -111,6 +111,16 @@ def test_chunked_get_suffix_range(cluster, chunked_fid):
         assert r.read() == data[-1234:]
 
 
+def test_range_416_carries_content_range(cluster, chunked_fid):
+    """RFC 7233 §4.4: a 416 must carry 'Content-Range: bytes */<total>'
+    so the client can learn the representation size."""
+    fid, data = chunked_fid
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        cluster.fetch(fid, headers={"Range": f"bytes={len(data)}-"})
+    assert ei.value.code == 416
+    assert ei.value.headers["Content-Range"] == f"bytes */{len(data)}"
+
+
 def test_cm_false_returns_raw_manifest(cluster, chunked_fid):
     fid, data = chunked_fid
     with cluster.fetch(fid + "?cm=false") as r:
@@ -146,6 +156,96 @@ def test_chunked_delete_cascades(cluster, chunked_fid):
     for cfid in chunk_fids:
         with pytest.raises(urllib.error.HTTPError):
             cluster.fetch(cfid)
+
+
+# -- reader location handling (reference chunked_file.go:176 looks up
+# -- each chunk fresh; our reader caches with TTL + forget-on-failure) -------
+
+
+def _reader_with_fakes(monkeypatch, locations, bodies, fail_urls=()):
+    """ChunkedFileReader whose master lookup and HTTP GETs are fakes.
+    `locations` maps vid -> list of urls (mutable — tests move volumes
+    mid-stream); `bodies` maps fid -> payload; `fail_urls` is a mutable
+    set of urls that refuse connections."""
+    from seaweedfs_tpu.operation import chunked_file, operations
+    lookups = []
+
+    def fake_lookup(master_url, vid, collection=""):
+        lookups.append(vid)
+        return list(locations.get(vid, []))
+
+    def fake_request(method, url, headers=None, timeout=None, **kw):
+        netloc, _, fid = url.partition("/")
+        if netloc in fail_urls:
+            raise ConnectionRefusedError(f"dead {netloc}")
+        data = bodies[fid]
+        status = 200
+        if headers and "Range" in headers:
+            lo, hi = headers["Range"][len("bytes="):].split("-")
+            data = data[int(lo):int(hi) + 1]
+            status = 206
+        return chunked_file.http_client.Response(status, {}, data)
+
+    monkeypatch.setattr(operations, "lookup", fake_lookup)
+    monkeypatch.setattr(chunked_file.http_client, "request", fake_request)
+    return lookups
+
+
+def test_reader_survives_volume_moving_servers_midstream(monkeypatch):
+    """Chunk 1 served from server A; A dies and the volume moves to B
+    before chunk 2 — the reader must forget the cached location,
+    re-ask the master, and finish the stream."""
+    from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
+                                                      ChunkedFileReader)
+    locations = {7: ["a:8080"]}
+    fail_urls = set()
+    bodies = {"7,0100000001": b"x" * 100, "7,0200000002": b"y" * 100}
+    lookups = _reader_with_fakes(monkeypatch, locations, bodies, fail_urls)
+    r = ChunkedFileReader([ChunkInfo("7,0100000001", 0, 100),
+                           ChunkInfo("7,0200000002", 100, 100)], "m:9333")
+    it = r.stream()
+    assert next(it) == b"x" * 100
+    fail_urls.add("a:8080")          # server A dies...
+    locations[7] = ["b:8080"]        # ...and the volume moves to B
+    assert next(it) == b"y" * 100    # forget + re-lookup + retry
+    assert lookups == [7, 7]
+
+
+def test_reader_fails_over_across_replicas_without_master(monkeypatch):
+    """With a healthy replica already in the cached location list, the
+    reader fails over without another master round trip."""
+    from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
+                                                      ChunkedFileReader)
+    locations = {7: ["a:8080", "b:8080"]}
+    bodies = {"7,0100000001": b"z" * 50}
+    lookups = _reader_with_fakes(monkeypatch, locations, bodies,
+                                 fail_urls={"a:8080"})
+    r = ChunkedFileReader([ChunkInfo("7,0100000001", 0, 50)], "m:9333")
+    assert r.read_all() == b"z" * 50
+    assert lookups == [7]
+
+
+def test_reader_raises_when_all_locations_stay_dead(monkeypatch):
+    from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
+                                                      ChunkedFileReader)
+    lookups = _reader_with_fakes(monkeypatch, {7: ["a:8080"]},
+                                 {"7,0100000001": b""}, fail_urls={"a:8080"})
+    r = ChunkedFileReader([ChunkInfo("7,0100000001", 0, 10)], "m:9333")
+    with pytest.raises(ConnectionRefusedError):
+        r.read_all()
+    assert lookups == [7, 7]  # forget triggered exactly one re-ask
+
+
+def test_reader_short_read_raises(monkeypatch):
+    """Manifest size disagreeing with the stored needle must surface
+    as an error, not silently misaligned bytes."""
+    from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
+                                                      ChunkedFileReader)
+    _reader_with_fakes(monkeypatch, {7: ["a:8080"]},
+                       {"7,0100000001": b"q" * 60})  # manifest claims 100
+    r = ChunkedFileReader([ChunkInfo("7,0100000001", 0, 100)], "m:9333")
+    with pytest.raises(RuntimeError, match="short read 60 != 100"):
+        r.read_all()
 
 
 def test_failed_submit_cleans_up_chunks(cluster, monkeypatch):

@@ -14,11 +14,17 @@ from seaweedfs_tpu.pb import filer_pb2
 
 @pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv",
                         "redis", "etcd", "mongodb", "cassandra",
-                        "elastic"])
+                        "elastic", "hbase"])
 def store(request, tmp_path):
     server = None
     if request.param == "memory":
         s = MemoryStore()
+    elif request.param == "hbase":
+        # real protobuf-framed region-server RPC against the fake
+        from seaweedfs_tpu.filer.stores.hbase_store import HBaseStore
+        from tests.fake_backends import FakeHBaseServer
+        server = FakeHBaseServer()
+        s = HBaseStore(port=server.port)
     elif request.param == "elastic":
         # real ES REST/JSON against the in-process fake
         from seaweedfs_tpu.filer.stores.elastic_store import ElasticStore

@@ -185,9 +185,11 @@ def test_cassandra_clustering_order_listing():
 
 def test_store_factory_knows_new_adapters(monkeypatch):
     from seaweedfs_tpu.server.filer import make_filer_store
-    from tests.fake_backends import FakeCassandraServer, FakeMongoServer
+    from tests.fake_backends import (FakeCassandraServer, FakeHBaseServer,
+                                     FakeMongoServer)
     mongo = FakeMongoServer()
     cas = FakeCassandraServer()
+    hb = FakeHBaseServer()
     try:
         s1 = make_filer_store(
             "mongodb", None,
@@ -198,12 +200,17 @@ def test_store_factory_knows_new_adapters(monkeypatch):
             "cassandra", None, {"hosts": [f"127.0.0.1:{cas.port}"]})
         assert s2.name == "cassandra"
         s2.close()
+        s3 = make_filer_store(
+            "hbase", None, {"zkquorum": f"127.0.0.1:{hb.port}"})
+        assert s3.name == "hbase"
+        s3.close()
     finally:
         mongo.stop()
         cas.stop()
+        hb.stop()
 
 
-@pytest.mark.parametrize("flavor", ["mongodb", "cassandra"])
+@pytest.mark.parametrize("flavor", ["mongodb", "cassandra", "hbase"])
 def test_prefix_listing_beyond_limit(flavor):
     """The prefix constraint must be applied server-side: filtering
     after LIMIT would silently drop matches in large directories."""
@@ -213,12 +220,17 @@ def test_prefix_listing_beyond_limit(flavor):
         from tests.fake_backends import FakeMongoServer
         server = FakeMongoServer()
         s = MongodbStore(port=server.port)
-    else:
+    elif flavor == "cassandra":
         from seaweedfs_tpu.filer.stores.cassandra_store import \
             CassandraStore
         from tests.fake_backends import FakeCassandraServer
         server = FakeCassandraServer()
         s = CassandraStore(port=server.port)
+    else:
+        from seaweedfs_tpu.filer.stores.hbase_store import HBaseStore
+        from tests.fake_backends import FakeHBaseServer
+        server = FakeHBaseServer()
+        s = HBaseStore(port=server.port)
     try:
         for i in range(30):
             s.insert_entry("/big", new_entry(f"a{i:04d}"))
@@ -253,3 +265,88 @@ def test_elastic_basic_auth_and_factory():
         s.close()
     finally:
         server.stop()
+
+
+# -- hbase (region-server RPC) ------------------------------------------------
+
+
+def test_hbase_scan_batching_and_scanner_close():
+    """Listings larger than one scan batch continue through the
+    scanner session (scanner_id + next_call_seq) and close it."""
+    from seaweedfs_tpu.filer.filer import new_entry
+    from seaweedfs_tpu.filer.stores.hbase_store import HBaseStore
+    from tests.fake_backends import FakeHBaseServer
+    srv = FakeHBaseServer()
+    s = HBaseStore(port=srv.port)
+    try:
+        for i in range(150):  # > the client's 64-row batch
+            s.insert_entry("/big", new_entry(f"e{i:04d}"))
+        got = [e.name for e in
+               s.list_directory_entries("/big", limit=1024)]
+        assert got == [f"e{i:04d}" for i in range(150)]
+        scans = [m for m in srv.calls if m == "Scan"]
+        assert len(scans) >= 3  # open + continuation(s) + close
+        assert not srv.scanners or all(
+            not rows for rows in srv.scanners.values())
+    finally:
+        s.close()
+        srv.stop()
+
+
+def test_hbase_ttl_attribute_and_gzip_threshold():
+    """TTL rides the '_ttl' mutation attribute in ms (gohbase
+    hrpc.TTL); entries with >50 chunks are gzip-compressed on the wire
+    and transparently decompressed on read (hbase_store.go:78-81)."""
+    import struct
+
+    from seaweedfs_tpu.filer.filer import new_entry
+    from seaweedfs_tpu.filer.stores.hbase_store import (CF_META,
+                                                        HBaseStore)
+    from seaweedfs_tpu.pb import filer_pb2
+    from tests.fake_backends import FakeHBaseServer
+    srv = FakeHBaseServer()
+    s = HBaseStore(port=srv.port)
+    try:
+        e = new_entry("timed", ttl_sec=90)
+        captured = {}
+        orig_put = s.client.put
+
+        def spy(family, row, value, ttl_sec=0):
+            captured["ttl"] = ttl_sec
+            return orig_put(family, row, value, ttl_sec=ttl_sec)
+
+        s.client.put = spy
+        s.insert_entry("/t", e)
+        assert captured["ttl"] == 90
+
+        big = new_entry("many-chunks")
+        for i in range(60):
+            big.chunks.add(file_id=f"3,{i:08x}ab", size=1)
+        s.insert_entry("/t", big)
+        raw = srv.rows[bytes(CF_META)][b"/t/many-chunks"]
+        assert raw[:2] == b"\x1f\x8b"  # stored gzipped
+        back = s.find_entry("/t", "many-chunks")
+        assert len(back.chunks) == 60
+    finally:
+        s.close()
+        srv.stop()
+
+
+def test_hbase_server_exception_surfaces():
+    """A ResponseHeader exception must raise HBaseError with the Java
+    class name, not be swallowed."""
+    from seaweedfs_tpu.filer.stores.hbase_store import (HBaseClient,
+                                                        HBaseError)
+    from seaweedfs_tpu.pb import hbase_pb2
+    from tests.fake_backends import FakeHBaseServer
+    srv = FakeHBaseServer()
+    c = HBaseClient(port=srv.port)
+    try:
+        with pytest.raises(HBaseError, match="UnknownScannerException"):
+            c._call("Scan",
+                    hbase_pb2.ScanRequest(scanner_id=999,
+                                          number_of_rows=1),
+                    hbase_pb2.ScanResponse)
+    finally:
+        c.close()
+        srv.stop()
