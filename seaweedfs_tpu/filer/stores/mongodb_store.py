@@ -69,7 +69,19 @@ def encode_doc(doc: dict) -> bytes:
 
 
 def decode_doc(buf: bytes, pos: int = 0) -> Tuple[dict, int]:
+    try:
+        return _decode_doc(buf, pos)
+    except (struct.error, IndexError, ValueError) as e:
+        # surface truncated/corrupt documents as protocol errors, not
+        # parser internals
+        raise MongoError(f"corrupt BSON document: {e}")
+
+
+def _decode_doc(buf: bytes, pos: int = 0) -> Tuple[dict, int]:
     (total,) = struct.unpack_from("<i", buf, pos)
+    if total < 5 or pos + total > len(buf):
+        raise MongoError(
+            f"corrupt BSON document: length {total} exceeds buffer")
     end = pos + total - 1  # trailing NUL
     pos += 4
     out: dict = {}

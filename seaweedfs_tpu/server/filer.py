@@ -63,6 +63,14 @@ def make_filer_store(store: str, meta_dir: Optional[str],
             port=int(opts.get("port", 6379)),
             password=opts.get("password", ""),
             database=int(opts.get("database", 0)))
+    if store in ("redis_cluster", "redis_cluster2"):
+        from seaweedfs_tpu.filer.stores.redis_store import \
+            RedisClusterStore
+        addrs = opts.get("addresses", ["localhost:6379"])
+        if isinstance(addrs, str):
+            addrs = [a.strip() for a in addrs.split(",") if a.strip()]
+        return RedisClusterStore(addrs,
+                                 password=opts.get("password", ""))
     if store == "etcd":
         from seaweedfs_tpu.filer.stores.etcd_store import EtcdStore
         return EtcdStore(endpoint=opts.get("servers", "127.0.0.1:2379"))

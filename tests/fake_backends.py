@@ -869,3 +869,225 @@ class FakeHBaseServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+# -- redis cluster (RESP + slot routing) --------------------------------------
+
+
+class FakeRedisCluster:
+    """Three slot-owning RESP nodes enforcing real cluster semantics:
+    keyed commands answer -MOVED when the slot lives elsewhere,
+    multi-key DEL crossing slots answers -CROSSSLOT, CLUSTER SLOTS
+    serves the live map, and ASK redirects work during a staged
+    migration (migrating-node answers -ASK for missing keys of a
+    migrating slot; the importing node requires ASKING first).
+    migrate_slot() moves a slot's data + ownership mid-test so MOVED
+    handling can be asserted."""
+
+    N_SLOTS = 16384
+
+    def __init__(self, n_nodes: int = 3):
+        from seaweedfs_tpu.filer.stores.redis_store import key_slot
+        self._key_slot = key_slot
+        self.nodes: List[dict] = []  # {port, data, sets, server}
+        self.owner: List[int] = []   # slot -> node index
+        self.migrating: Dict[int, Tuple[int, int]] = {}  # slot -> (src, dst)
+        per = self.N_SLOTS // n_nodes
+        for i in range(n_nodes):
+            self.owner += [i] * (per if i < n_nodes - 1
+                                 else self.N_SLOTS - per * (n_nodes - 1))
+        outer = self
+        for i in range(n_nodes):
+            node = {"port": free_port_pair(), "data": {}, "sets": {},
+                    "index": i}
+
+            class Handler(socketserver.StreamRequestHandler):
+                _node = node
+
+                def handle(self):
+                    self.asking = False
+                    while True:
+                        try:
+                            parts = self._read_command()
+                        except (ValueError, ConnectionError):
+                            return
+                        if parts is None:
+                            return
+                        try:
+                            self._dispatch(parts)
+                        except (BrokenPipeError, ConnectionError):
+                            return
+
+                def _read_command(self):
+                    line = self.rfile.readline()
+                    if not line:
+                        return None
+                    n = int(line[1:])
+                    parts = []
+                    for _ in range(n):
+                        hdr = self.rfile.readline()
+                        size = int(hdr[1:])
+                        parts.append(self.rfile.read(size + 2)[:-2])
+                    return parts
+
+                def _bulk_array(self, items):
+                    out = [b"*%d\r\n" % len(items)]
+                    for it in items:
+                        out.append(b"$%d\r\n%s\r\n" % (len(it), it))
+                    return b"".join(out)
+
+                def _route_check(self, keys) -> bool:
+                    """True if this node may serve these keys; replies
+                    with the redirect/error itself otherwise."""
+                    me = self._node["index"]
+                    slots = {outer._key_slot(k) for k in keys}
+                    if len(slots) > 1:
+                        self.wfile.write(
+                            b"-CROSSSLOT Keys in request don't hash "
+                            b"to the same slot\r\n")
+                        return False
+                    slot = slots.pop()
+                    owner = outer.owner[slot]
+                    mig = outer.migrating.get(slot)
+                    if owner == me:
+                        # migrating away: keys already moved answer ASK
+                        if mig and mig[0] == me and \
+                                not any(k in self._node["data"] or
+                                        k in self._node["sets"]
+                                        for k in keys):
+                            dst = outer.nodes[mig[1]]
+                            self.wfile.write(
+                                b"-ASK %d 127.0.0.1:%d\r\n"
+                                % (slot, dst["port"]))
+                            return False
+                        return True
+                    if mig and mig[1] == me and self.asking:
+                        return True  # importing + client said ASKING
+                    target = outer.nodes[owner]
+                    self.wfile.write(b"-MOVED %d 127.0.0.1:%d\r\n"
+                                     % (slot, target["port"]))
+                    return False
+
+                def _dispatch(self, parts):
+                    cmd = parts[0].upper()
+                    asking, self.asking = self.asking, False
+                    data, sets = self._node["data"], self._node["sets"]
+                    if cmd == b"ASKING":
+                        self.asking = True
+                        self.wfile.write(b"+OK\r\n")
+                        return
+                    if cmd in (b"AUTH", b"SELECT", b"PING"):
+                        self.asking = asking
+                        self.wfile.write(b"+OK\r\n")
+                        return
+                    if cmd == b"CLUSTER" and parts[1].upper() == b"SLOTS":
+                        rows = []
+                        start = 0
+                        for slot in range(1, outer.N_SLOTS + 1):
+                            if slot == outer.N_SLOTS or \
+                                    outer.owner[slot] != outer.owner[start]:
+                                n = outer.nodes[outer.owner[start]]
+                                node_id = b"node%d" % outer.nodes.index(n)
+                                rows.append(
+                                    b"*3\r\n:%d\r\n:%d\r\n" % (start, slot - 1)
+                                    + b"*3\r\n$9\r\n127.0.0.1\r\n:%d\r\n"
+                                    % n["port"]
+                                    + b"$%d\r\n%s\r\n" % (len(node_id),
+                                                          node_id))
+                                start = slot
+                        self.wfile.write(b"*%d\r\n" % len(rows)
+                                         + b"".join(rows))
+                        return
+                    if cmd == b"SCAN":
+                        import fnmatch
+                        pat = b"*"
+                        for j in range(2, len(parts) - 1):
+                            if parts[j].upper() == b"MATCH":
+                                pat = parts[j + 1]
+                        keys = [k for k in list(data) + list(sets)
+                                if fnmatch.fnmatchcase(
+                                    k.decode("latin1"),
+                                    pat.decode("latin1"))]
+                        self.wfile.write(b"*2\r\n$1\r\n0\r\n"
+                                         + self._bulk_array(keys))
+                        return
+                    # keyed commands below
+                    self.asking = asking
+                    if cmd in (b"SET", b"GET", b"SADD", b"SREM",
+                               b"SMEMBERS"):
+                        keys = [parts[1]]
+                    elif cmd == b"DEL":
+                        keys = parts[1:]
+                    else:
+                        self.wfile.write(b"-ERR unknown command\r\n")
+                        return
+                    if not self._route_check(keys):
+                        return
+                    self.asking = False
+                    if cmd == b"SET":
+                        data[parts[1]] = parts[2]
+                        self.wfile.write(b"+OK\r\n")
+                    elif cmd == b"GET":
+                        v = data.get(parts[1])
+                        self.wfile.write(
+                            b"$-1\r\n" if v is None
+                            else b"$%d\r\n%s\r\n" % (len(v), v))
+                    elif cmd == b"DEL":
+                        n = 0
+                        for k in keys:
+                            n += data.pop(k, None) is not None
+                            n += sets.pop(k, None) is not None
+                        self.wfile.write(b":%d\r\n" % n)
+                    elif cmd == b"SADD":
+                        s = sets.setdefault(parts[1], set())
+                        before = len(s)
+                        s.update(parts[2:])
+                        self.wfile.write(b":%d\r\n" % (len(s) - before))
+                    elif cmd == b"SREM":
+                        s = sets.get(parts[1], set())
+                        n = len(s)
+                        s.difference_update(parts[2:])
+                        self.wfile.write(b":%d\r\n" % (n - len(s)))
+                    elif cmd == b"SMEMBERS":
+                        self.wfile.write(
+                            self._bulk_array(sorted(sets.get(parts[1],
+                                                             set()))))
+
+            server = socketserver.ThreadingTCPServer(
+                ("127.0.0.1", node["port"]), Handler)
+            server.daemon_threads = True
+            node["server"] = server
+            threading.Thread(target=server.serve_forever,
+                             daemon=True).start()
+            self.nodes.append(node)
+
+    @property
+    def addresses(self):
+        return [f"127.0.0.1:{n['port']}" for n in self.nodes]
+
+    def slot_of(self, key: bytes) -> int:
+        return self._key_slot(key)
+
+    def begin_migration(self, slot: int, dst: int) -> None:
+        """Stage an ASK-answering migration of `slot` to node `dst`
+        (data stays put until finish_migration/migrate_slot)."""
+        self.migrating[slot] = (self.owner[slot], dst)
+
+    def migrate_slot(self, slot: int, dst: int) -> None:
+        """Move a slot's keys + ownership to node `dst`; the old owner
+        answers -MOVED afterwards."""
+        src = self.owner[slot]
+        if src == dst:
+            return
+        for kind in ("data", "sets"):
+            src_map = self.nodes[src][kind]
+            for k in [k for k in src_map
+                      if self._key_slot(k) == slot]:
+                self.nodes[dst][kind][k] = src_map.pop(k)
+        self.owner[slot] = dst
+        self.migrating.pop(slot, None)
+
+    def stop(self):
+        for n in self.nodes:
+            n["server"].shutdown()
+            n["server"].server_close()

@@ -13,8 +13,8 @@ from seaweedfs_tpu.pb import filer_pb2
 
 
 @pytest.fixture(params=["memory", "sqlite", "sqlite-file", "weedkv",
-                        "redis", "etcd", "mongodb", "cassandra",
-                        "elastic", "hbase"])
+                        "redis", "redis-cluster", "etcd", "mongodb",
+                        "cassandra", "elastic", "hbase"])
 def store(request, tmp_path):
     server = None
     if request.param == "memory":
@@ -55,6 +55,14 @@ def store(request, tmp_path):
         from tests.fake_backends import FakeRedisServer
         server = FakeRedisServer()
         s = RedisStore(port=server.port)
+    elif request.param == "redis-cluster":
+        # slot-routed RESP against a 3-node fake cluster (MOVED/ASK/
+        # CROSSSLOT enforced server-side)
+        from seaweedfs_tpu.filer.stores.redis_store import \
+            RedisClusterStore
+        from tests.fake_backends import FakeRedisCluster
+        server = FakeRedisCluster()
+        s = RedisClusterStore(server.addresses)
     elif request.param == "etcd":
         from seaweedfs_tpu.filer.stores.etcd_store import EtcdStore
         from tests.fake_backends import FakeEtcdServer

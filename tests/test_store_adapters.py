@@ -350,3 +350,92 @@ def test_hbase_server_exception_surfaces():
     finally:
         c.close()
         srv.stop()
+
+
+# -- redis cluster (slot routing) ---------------------------------------------
+
+
+def test_redis_cluster_key_slot_vectors():
+    """CRC16/XMODEM key-slot vectors from the cluster spec: published
+    values plus the hash-tag rule (only the {span} hashes; empty tags
+    hash the whole key)."""
+    from seaweedfs_tpu.filer.stores.redis_store import crc16, key_slot
+    assert crc16(b"123456789") == 0x31C3  # the spec's own check value
+    assert key_slot(b"") == crc16(b"") % 16384
+    assert key_slot(b"foo{bar}baz") == key_slot(b"{bar}") == \
+        key_slot(b"bar")
+    assert key_slot(b"foo{}bar") == crc16(b"foo{}bar") % 16384
+    assert key_slot(b"{user1000}.following") == \
+        key_slot(b"{user1000}.followers")
+
+
+def test_redis_cluster_survives_mid_test_slot_migration():
+    """A slot moving nodes mid-run answers -MOVED; the client must
+    remap and finish, and later commands go straight to the new
+    owner."""
+    from seaweedfs_tpu.filer.filer import new_entry
+    from seaweedfs_tpu.filer.stores.redis_store import RedisClusterStore
+    from tests.fake_backends import FakeRedisCluster
+    cl = FakeRedisCluster()
+    s = RedisClusterStore(cl.addresses)
+    try:
+        s.insert_entry("/m", new_entry("moved.txt"))
+        slot = cl.slot_of(b"/m/moved.txt")
+        dst = (cl.owner[slot] + 1) % len(cl.nodes)
+        cl.migrate_slot(slot, dst)
+        assert s.find_entry("/m", "moved.txt").name == "moved.txt"
+        # map was refreshed: the direct route now hits the new owner
+        assert s.client._node_for(slot) == \
+            ("127.0.0.1", cl.nodes[dst]["port"])
+    finally:
+        s.close()
+        cl.stop()
+
+
+def test_redis_cluster_ask_redirect():
+    """During a staged migration the old owner answers -ASK for keys
+    already gone; the client must send ASKING to the target and NOT
+    remap the slot."""
+    from seaweedfs_tpu.filer.filer import new_entry
+    from seaweedfs_tpu.filer.stores.redis_store import (RedisClusterStore,
+                                                        key_slot)
+    from tests.fake_backends import FakeRedisCluster
+    cl = FakeRedisCluster()
+    s = RedisClusterStore(cl.addresses)
+    try:
+        slot = key_slot(b"/a/ask.txt")
+        src = cl.owner[slot]
+        dst = (src + 1) % len(cl.nodes)
+        cl.begin_migration(slot, dst)  # key absent at src -> ASK
+        s.insert_entry("/a", new_entry("ask.txt"))
+        # the write landed on the importing node via ASKING
+        assert any(k == b"/a/ask.txt"
+                   for k in cl.nodes[dst]["data"]), \
+            list(cl.nodes[dst]["data"])
+        # slot map unchanged: ASK is one-shot
+        assert s.client._node_for(slot) == \
+            ("127.0.0.1", cl.nodes[src]["port"])
+    finally:
+        s.close()
+        cl.stop()
+
+
+def test_redis_cluster_delete_many_groups_by_slot():
+    """delete_many must split a cross-slot key set into per-slot DELs
+    (the fake answers -CROSSSLOT otherwise)."""
+    from seaweedfs_tpu.filer.stores.redis_store import (RedisClusterStore,
+                                                        key_slot)
+    from tests.fake_backends import FakeRedisCluster
+    cl = FakeRedisCluster()
+    s = RedisClusterStore(cl.addresses)
+    try:
+        keys = [f"/cs/k{i}".encode() for i in range(12)]
+        assert len({key_slot(k) for k in keys}) > 1  # really cross-slot
+        for k in keys:
+            s.client.command(b"SET", k, b"x")
+        s.client.delete_many(keys)
+        for k in keys:
+            assert s.client.command(b"GET", k) is None
+    finally:
+        s.close()
+        cl.stop()
