@@ -1,6 +1,6 @@
-"""BASELINE.md config-matrix measurements (configs 1-7).
+"""BASELINE.md config-matrix measurements (configs 1-8).
 
-Usage: python bench_configs.py [1|2|3|4|5|6|7|all]
+Usage: python bench_configs.py [1|2|3|4|5|6|7|8|all]
 
 Each config prints one JSON line; results are recorded in BASELINE.md.
 Config definitions come from BASELINE.json / BASELINE.md:
@@ -308,10 +308,125 @@ def config7() -> dict:
     return out
 
 
+def _drive(n: int, concurrency: int, op) -> dict:
+    """Run op(i) from `concurrency` threads, n times total; returns
+    req/s + latency percentiles (the config-7 stats shape)."""
+    import threading
+    import time as _t
+    lat = []
+    lock = threading.Lock()
+    counter = iter(range(n))
+    failed = [0]
+
+    def worker():
+        while True:
+            with lock:
+                i = next(counter, None)
+            if i is None:
+                return
+            t0 = _t.monotonic()
+            try:
+                op(i)
+                dt = (_t.monotonic() - t0) * 1e3
+                with lock:
+                    lat.append(dt)
+            except Exception:
+                with lock:
+                    failed[0] += 1
+
+    t0 = _t.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    secs = _t.monotonic() - t0
+    lat.sort()
+    pct = lambda p: round(lat[min(len(lat) - 1, int(p * len(lat)))], 2) \
+        if lat else 0.0
+    return {"req_per_s": round(len(lat) / secs, 1), "p50_ms": pct(0.5),
+            "p99_ms": pct(0.99), "failed": failed[0]}
+
+
+class _SigV4:
+    """Pooled-transport S3 bench client: signature math rides the
+    repo's own util.aws_auth.sigv4_headers (the same canonical-request
+    chain the gateway verifies); only the send path is the pooled
+    keep-alive client."""
+
+    def __init__(self, endpoint, access, secret, region="us-east-1"):
+        self.endpoint, self.access = endpoint, access
+        self.secret, self.region = secret, region
+
+    def request(self, method: str, path: str, payload: bytes = b""):
+        from seaweedfs_tpu.util import http_client
+        from seaweedfs_tpu.util.aws_auth import sigv4_headers
+        headers = sigv4_headers(method, self.endpoint, path, [], {},
+                                payload, self.access, self.secret,
+                                self.region, "s3")
+        headers.pop("host", None)  # the pooled client sets Host itself
+        r = http_client.request(
+            method, f"{self.endpoint}{path}", body=payload or None,
+            headers=headers)
+        if r.status >= 300:
+            raise RuntimeError(f"s3 {method} {path}: {r.status}")
+        return r
+
+
+def config8() -> dict:
+    """Filer + S3 data planes (VERDICT r4 #2): same 1KB/c=16 shape as
+    config 7 but through filer POST/GET /path (auto-chunking,
+    filer_server_handlers_write_autochunk.go) and s3 PUT/GET (SigV4,
+    s3api/auth_signature_v4.go)."""
+    import pathlib
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from seaweedfs_tpu.s3api.auth import (ACTION_ADMIN, Credential, Iam,
+                                          Identity)
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from seaweedfs_tpu.util import http_client
+    from tests.cluster_util import Cluster, free_port_pair
+
+    n = int(os.environ.get("BENCH8_N", 15_000))  # BASELINE.md runs use 15k
+    c16 = 16
+    payload = bytes(i * 31 % 256 for i in range(1024))
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="bench8-"))
+    cluster = Cluster(tmp, n_volume_servers=1, with_filer=True)
+    s3srv = S3ApiServer(
+        filer_url=cluster.filer.url, port=free_port_pair(),
+        iam=Iam([Identity(name="bench",
+                          credentials=[Credential("benchak", "benchsk")],
+                          actions=[ACTION_ADMIN])]))
+    s3srv.start()
+    out = {"config": 8, "n": n}
+    try:
+        filer = cluster.filer.url
+        out["filer_write"] = _drive(
+            n, c16, lambda i: http_client.request(
+                "POST", f"{filer}/bench/f{i}", body=payload))
+        out["filer_read"] = _drive(
+            n, c16, lambda i: http_client.request(
+                "GET", f"{filer}/bench/f{i}"))
+        s3c = _SigV4(s3srv.url, "benchak", "benchsk")
+        s3c.request("PUT", "/benchbkt")
+        out["s3_write"] = _drive(
+            n, c16, lambda i: s3c.request("PUT", f"/benchbkt/o{i}",
+                                          payload))
+        out["s3_read"] = _drive(
+            n, c16, lambda i: s3c.request("GET", f"/benchbkt/o{i}"))
+    finally:
+        s3srv.stop()
+        cluster.stop()
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     configs = {"1": config1, "2": config2, "3": config3, "4": config4,
-               "5": config5, "6": config6, "7": config7}
+               "5": config5, "6": config6, "7": config7, "8": config8}
     if which == "all":
         # each config in its own subprocess: config2 initializes the
         # TPU backend in-process, which would make config4's

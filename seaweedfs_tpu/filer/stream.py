@@ -8,8 +8,9 @@ TieredChunkCache, and yields the visible byte ranges in order.
 
 from __future__ import annotations
 
-import urllib.request
 from typing import Callable, Iterator, List, Optional
+
+from seaweedfs_tpu.util import http_client
 
 from seaweedfs_tpu.filer import filechunks
 from seaweedfs_tpu.filer.filechunk_manifest import resolve_chunk_manifest
@@ -45,18 +46,24 @@ def fetch_chunk_bytes(lookup: LookupFn, file_id: str,
             return hit
     urls = lookup(file_id)
     err: Optional[Exception] = None
+    data = None
     for url in urls:
+        # pooled keep-alive client: chunk fetches are the filer read
+        # path's inner hop, and a fresh connection per chunk is both a
+        # syscall tax and an occasional 1s SYN-retransmit p99 spike
         try:
-            req = urllib.request.Request(
-                f"http://{url}/{file_id}",
+            r = http_client.request(
+                "GET", f"{url}/{file_id}",
                 # raw stored bytes, no server-side decompression
-                headers={"Accept-Encoding": "gzip"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                data = r.read()
-            break
-        except OSError as e:
+                headers={"Accept-Encoding": "gzip"}, timeout=60.0)
+        except (OSError, http_client._StaleConnection) as e:
             err = e
-    else:
+            continue
+        if r.status == 200:
+            data = r.body
+            break
+        err = IOError(f"http {r.status}")
+    if data is None:
         raise IOError(f"fetch {file_id}: no reachable replica: {err}")
     if cipher_key:
         data = decrypt(data, cipher_key)

@@ -145,9 +145,11 @@ class S3ApiServer:
                   mime: str = "") -> Tuple[dict, dict]:
         return filer_http.put(self.filer_url, path, data, mime)
 
-    def filer_get(self, path: str,
-                  range_header: Optional[str] = None) -> Tuple[int, bytes, dict]:
-        return filer_http.get(self.filer_url, path, range_header)
+    def filer_get(self, path: str, range_header: Optional[str] = None,
+                  extra_headers: Optional[dict] = None
+                  ) -> Tuple[int, bytes, dict]:
+        return filer_http.get(self.filer_url, path, range_header,
+                              extra_headers=extra_headers)
 
     def find_entry(self, directory: str, name: str) -> Optional[filer_pb2.Entry]:
         try:
@@ -446,12 +448,13 @@ def _make_handler(s3: S3ApiServer):
             self._reply(200, headers={"ETag": f'"{etag.strip(chr(34))}"'})
 
         def _get_object(self, bucket: str, key: str):
-            entry = s3.find_entry(_dir_of(bucket, key), _name_of(key))
-            if entry is None or entry.is_directory:
-                return self._error("NoSuchKey", key, 404)
             rng = self.headers.get("Range")
-            size = filechunks.total_size(entry.chunks)
             if self.command == "HEAD":
+                entry = s3.find_entry(_dir_of(bucket, key),
+                                      _name_of(key))
+                if entry is None or entry.is_directory:
+                    return self._error("NoSuchKey", key, 404)
+                size = filechunks.total_size(entry.chunks)
                 return self._reply(200, headers={
                     "Content-Length": str(size),
                     "Content-Type": entry.attributes.mime or
@@ -460,12 +463,23 @@ def _make_handler(s3: S3ApiServer):
                     if entry.chunks else '""',
                     "Last-Modified": _http_date(entry.attributes.mtime),
                 })
+            # GET proxies the filer in ONE hop (reference
+            # s3api_object_handlers.go proxyToFiler): the filer reply
+            # already carries ETag/Content-Type/Content-Range, and
+            # x-sw-object-only makes directory keys 404 instead of a
+            # listing, so no pre-lookup gRPC round trip is needed
             try:
                 status, data, headers = s3.filer_get(
-                    f"{BUCKETS_DIR}/{bucket}/{key}", rng)
+                    f"{BUCKETS_DIR}/{bucket}/{key}", rng,
+                    extra_headers={"x-sw-object-only": "true"})
             except urllib.error.HTTPError as e:  # noqa: F821
-                return self._error("NoSuchKey", key, e.code)
-            out = {"Content-Type": entry.attributes.mime or
+                if e.code == 404:
+                    return self._error("NoSuchKey", key, 404)
+                # a transient backend failure must NOT masquerade as a
+                # missing object (sync clients treat NoSuchKey as
+                # deletion)
+                return self._error("InternalError", key, e.code)
+            out = {"Content-Type": headers.get("Content-Type") or
                    "application/octet-stream"}
             for h in ("Content-Range", "ETag"):
                 if h in headers:

@@ -637,6 +637,13 @@ def _make_http_handler(fs: FilerServer):
                 self._json({"error": f"{path} not found"}, code=404)
                 return
             if entry.is_directory:
+                if self.headers.get("x-sw-object-only"):
+                    # gateway proxy mode (S3): a directory is not an
+                    # object — 404 instead of a listing, so the gateway
+                    # can proxy GETs in one hop without a pre-lookup
+                    self._json({"error": f"{path} is a directory"},
+                               code=404)
+                    return
                 self._list_dir(path, params)
                 return
             self._serve_file(path, entry)
@@ -738,7 +745,9 @@ def _make_http_handler(fs: FilerServer):
                         f"bytes {offset}-{end}/{size}"
                     code = 206
                 except ValueError:
-                    self._reply(416)
+                    # RFC 7233 §4.4: 416 carries the representation size
+                    self._reply(416, headers={
+                        "Content-Range": f"bytes */{size}"})
                     return
             if self.command == "HEAD":
                 headers["Content-Length"] = str(length)

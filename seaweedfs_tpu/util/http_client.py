@@ -148,10 +148,12 @@ def request(method: str, url: str, body: Optional[bytes] = None,
     raise RuntimeError("unreachable")
 
 
-class _StaleConnection(Exception):
+class _StaleConnection(OSError):
     """Connection-level failure. retryable=True means no response byte
     arrived AND the request cannot have been durably received (safe to
-    replay on a fresh connection)."""
+    replay on a fresh connection). Subclasses OSError so callers'
+    pre-pooled-client `except OSError` error handling keeps catching
+    connection-level failures."""
 
     def __init__(self, msg, retryable: bool = False):
         super().__init__(msg)
@@ -161,14 +163,22 @@ class _StaleConnection(Exception):
 def _roundtrip(conn: "_Conn", netloc: str, method: str, path: str,
                body: Optional[bytes],
                headers: Optional[dict]) -> Tuple[Response, bool]:
-    buf = [f"{method} {path} HTTP/1.1\r\nHost: {netloc}\r\n"
-           "Accept-Encoding: identity\r\n"]
+    buf = [f"{method} {path} HTTP/1.1\r\nHost: {netloc}\r\n"]
     has_len = False
+    has_enc = False
     if headers:
         for k, v in headers.items():
             buf.append(f"{k}: {v}\r\n")
-            if k.lower() == "content-length":
+            kl = k.lower()
+            if kl == "content-length":
                 has_len = True
+            elif kl == "accept-encoding":
+                has_enc = True
+    if not has_enc:
+        # default to identity (this client never decompresses), but a
+        # caller-supplied Accept-Encoding must win — the server parses
+        # first-value-wins
+        buf.append("Accept-Encoding: identity\r\n")
     if body is not None and not has_len:
         buf.append(f"Content-Length: {len(body)}\r\n")
     elif body is None and method in ("POST", "PUT"):
