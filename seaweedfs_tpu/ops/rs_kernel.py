@@ -176,9 +176,34 @@ _MIN_SLAB = 1 << 16   # 64KB
 _MAX_SLAB = 1 << 22   # 4MB lanes per dispatch (40MB data for S=10)
 
 
+@functools.lru_cache(maxsize=1)
+def _lane_sharding():
+    """NamedSharding splitting the lane axis over the devices (None on
+    a single-device host). The GF map is per-byte-column, so lane
+    sharding is embarrassingly parallel — no collectives — and this
+    makes the ordinary service path (volume-server ec.encode ->
+    write_ec_files -> apply_matrix) a mesh program on multi-chip hosts
+    with no caller changes: XLA partitions the same jitted kernel.
+
+    The mesh takes the largest power-of-two prefix of the device list:
+    slab widths are powers of two (>= 2^16), so a power-of-two mesh
+    always divides them — a 6-device host shards over 4 rather than
+    silently not sharding at all."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return None
+    n = 1
+    while n * 2 <= len(devs):
+        n *= 2
+    mesh = Mesh(np.array(devs[:n]), ("lanes",))
+    return NamedSharding(mesh, PartitionSpec(None, "lanes"))
+
+
 def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray):
     """Issue one async dispatch per power-of-two slab; no fetches."""
     s, n = flat.shape
+    sharding = _lane_sharding()
     parts = []
     pos = 0
     while pos < n:
@@ -191,6 +216,13 @@ def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray):
             padded = np.zeros((s, slab), dtype=np.uint8)
             padded[:, :want] = chunk
             chunk = padded
-        parts.append((_gf_linear_jit(m2, jnp.asarray(chunk)), want, pos))
+        if sharding is not None and slab % sharding.mesh.size == 0:
+            # device_put the HOST array straight onto the sharding:
+            # each device receives only its lane slice (going through
+            # device 0 first would double the interconnect traffic)
+            x = jax.device_put(np.ascontiguousarray(chunk), sharding)
+        else:
+            x = jnp.asarray(chunk)
+        parts.append((_gf_linear_jit(m2, x), want, pos))
         pos += want
     return parts
