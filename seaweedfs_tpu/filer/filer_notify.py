@@ -133,14 +133,26 @@ class MetaLog:
         yield site (server/filer.py _advance_and_filter) where the
         scanned timestamps are still visible. A reader-side prefix
         filter here once made prefix subscribers spin at 100% CPU."""
-        seen = set()
-        entries: List[LogEntry] = []
-        for e in self._disk_entries(since_ns) + self.buffer.read_since(since_ns):
-            if e.ts_ns in seen:
-                continue
-            seen.add(e.ts_ns)
-            entries.append(e)
-        entries.sort(key=lambda e: e.ts_ns)
+        earliest = self.buffer.earliest_in_memory()
+        if earliest is not None and earliest <= since_ns:
+            # the in-memory buffer (pending + retained flushed batches)
+            # reaches back past the cursor: every entry > since_ns is
+            # in memory, so skip the disk segments entirely. Without
+            # this, each poll of a streaming subscriber re-reads and
+            # re-unpacks the current minute segment from disk — O(n^2)
+            # across a busy minute (the reference draws the same
+            # memory-vs-disk boundary, filer/filer_notify_read.go).
+            entries = self.buffer.read_since(since_ns)
+        else:
+            seen = set()
+            entries = []
+            for e in self._disk_entries(since_ns) + \
+                    self.buffer.read_since(since_ns):
+                if e.ts_ns in seen:
+                    continue
+                seen.add(e.ts_ns)
+                entries.append(e)
+            entries.sort(key=lambda e: e.ts_ns)
         out = []
         for e in entries:
             rec = filer_pb2.SubscribeMetadataResponse()
