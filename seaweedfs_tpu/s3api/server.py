@@ -214,17 +214,22 @@ def _make_handler(s3: S3ApiServer):
         def _reply(self, code: int, body: bytes = b"",
                    headers: Optional[dict] = None,
                    content_type: str = "application/xml") -> None:
+            # HEAD replies pass the object's Content-Length explicitly
+            # (a second zero-length one would violate RFC 7230), and 204
+            # replies MUST NOT carry Content-Length at all (RFC 9110
+            # §8.6) — those two shapes keep the header-by-header path;
+            # everything else rides the single-buffer fast_reply.
+            explicit_len = any(k.lower() == "content-length"
+                               for k in (headers or {}))
+            if code != 204 and not explicit_len:
+                self.fast_reply(code, body, headers,
+                                ctype=content_type if body else "")
+                return
             self.send_response(code)
             if body:
                 self.send_header("Content-Type", content_type)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
-            # HEAD replies pass the object's Content-Length explicitly
-            # (a second zero-length one would violate RFC 7230), and 204
-            # replies MUST NOT carry Content-Length at all (RFC 9110 §8.6).
-            if code != 204 and not any(k.lower() == "content-length"
-                                       for k in (headers or {})):
-                self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             if self.command != "HEAD" and body:
                 self.wfile.write(body)
