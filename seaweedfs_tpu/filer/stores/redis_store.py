@@ -25,6 +25,12 @@ class RespError(Exception):
     pass
 
 
+class RespConnectionError(RespError, OSError):
+    """Connection-level RESP failure (peer closed / reset): distinct
+    from a server -ERR reply so cluster routing can treat it as a node
+    failure (drop the connection, refresh the slot map, re-route)."""
+
+
 class RespClient:
     """One redis connection; thread-safe via a lock (the store's call
     pattern is short request/response, no pipelining needed)."""
@@ -52,7 +58,7 @@ class RespClient:
     def _read_reply(self):
         line = self._buf.readline()
         if not line:
-            raise RespError("connection closed")
+            raise RespConnectionError("connection closed")
         kind, rest = line[:1], line[1:-2]
         if kind == b"+":
             return rest
@@ -237,6 +243,14 @@ class RedisClusterClient:
                 if asking:
                     return conn.command_asking(*parts)
                 return conn.command(*parts)
+            except RespConnectionError:
+                # node died mid-conversation: same treatment as a
+                # failed dial — drop, re-learn the map, re-route
+                self._drop_conn(node)
+                self.refresh_slots()
+                node = self._node_for(slot)
+                asking = False
+                continue
             except RespError as e:
                 msg = str(e)
                 if msg.startswith("MOVED "):

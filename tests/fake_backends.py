@@ -899,7 +899,7 @@ class FakeRedisCluster:
         outer = self
         for i in range(n_nodes):
             node = {"port": free_port_pair(), "data": {}, "sets": {},
-                    "index": i}
+                    "index": i, "dead": False}
 
             class Handler(socketserver.StreamRequestHandler):
                 _node = node
@@ -913,6 +913,8 @@ class FakeRedisCluster:
                             return
                         if parts is None:
                             return
+                        if self._node["dead"]:
+                            return  # crashed node: close mid-conversation
                         try:
                             self._dispatch(parts)
                         except (BrokenPipeError, ConnectionError):
@@ -1072,6 +1074,13 @@ class FakeRedisCluster:
         """Stage an ASK-answering migration of `slot` to node `dst`
         (data stays put until finish_migration/migrate_slot)."""
         self.migrating[slot] = (self.owner[slot], dst)
+
+    def kill_node(self, i: int) -> None:
+        """Simulate a node crash: stop accepting, and established
+        connections close on their next command."""
+        self.nodes[i]["dead"] = True
+        self.nodes[i]["server"].shutdown()
+        self.nodes[i]["server"].server_close()
 
     def migrate_slot(self, slot: int, dst: int) -> None:
         """Move a slot's keys + ownership to node `dst`; the old owner

@@ -439,3 +439,34 @@ def test_redis_cluster_delete_many_groups_by_slot():
     finally:
         s.close()
         cl.stop()
+
+
+def test_redis_cluster_fails_over_when_a_node_dies():
+    """A node crashing mid-conversation (connection closes) must be
+    treated like a dial failure: drop the pooled connection, re-learn
+    the slot map from the surviving nodes, and re-route — not surface
+    a raw 'connection closed' error."""
+    from seaweedfs_tpu.filer.filer import new_entry
+    from seaweedfs_tpu.filer.stores.redis_store import (RedisClusterStore,
+                                                        key_slot)
+    from tests.fake_backends import FakeRedisCluster
+    cl = FakeRedisCluster()
+    s = RedisClusterStore(cl.addresses)
+    try:
+        s.insert_entry("/ha", new_entry("survivor.txt"))
+        slot = key_slot(b"/ha/survivor.txt")
+        src = cl.owner[slot]
+        dst = (src + 1) % len(cl.nodes)
+        # the node fails over to its replica: data + ownership move,
+        # then the old primary crashes (map changes reach the client
+        # only through its own refresh)
+        cl.migrate_slot(slot, dst)
+        cl.kill_node(src)
+        got = s.find_entry("/ha", "survivor.txt")
+        assert got.name == "survivor.txt"
+        # the refreshed map routes straight to the new owner now
+        assert s.client._node_for(slot) == \
+            ("127.0.0.1", cl.nodes[dst]["port"])
+    finally:
+        s.close()
+        cl.stop()

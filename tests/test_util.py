@@ -187,3 +187,67 @@ class TestRetry:
         with pytest.raises(NonRetryableError):
             retry("op", fn, wait_seconds=0.001)
         assert len(calls) == 1
+
+
+class TestHeaderBlockParser:
+    """parse_header_block (shared by FastHandler and the pooled
+    client): the peek fast path AND the readline fallback when the
+    header block is not yet fully buffered."""
+
+    def _server_socket_pair(self):
+        import socket
+        a, b = socket.socketpair()
+        return a, b.makefile("rb", buffering=65536)
+
+    def test_fast_path_one_buffered_block(self):
+        from seaweedfs_tpu.util.http_server import parse_header_block
+        w, rfile = self._server_socket_pair()
+        w.sendall(b"Content-Length: 12\r\nX-Custom: a b\r\n"
+                  b"X-Custom: dup-ignored\r\n\r\nBODY")
+        headers = {}
+        assert parse_header_block(rfile, headers) is None
+        assert headers == {"content-length": "12", "x-custom": "a b"}
+        assert rfile.read(4) == b"BODY"  # body bytes untouched
+        w.close()
+
+    def test_fallback_when_headers_dribble_in(self):
+        """Headers arriving in tiny TCP segments miss the peek window,
+        so the readline fallback must produce the identical parse."""
+        import threading
+        import time
+
+        from seaweedfs_tpu.util.http_server import parse_header_block
+        w, rfile = self._server_socket_pair()
+
+        def dribble():
+            for piece in (b"Content-", b"Length: 5\r\n",
+                          b"X-Thing: v\r\n", b"\r\n", b"HELLO"):
+                w.sendall(piece)
+                time.sleep(0.02)
+
+        t = threading.Thread(target=dribble, daemon=True)
+        t.start()
+        headers = {}
+        assert parse_header_block(rfile, headers) is None
+        assert headers == {"content-length": "5", "x-thing": "v"}
+        assert rfile.read(5) == b"HELLO"
+        t.join()
+        w.close()
+
+    def test_zero_headers(self):
+        from seaweedfs_tpu.util.http_server import parse_header_block
+        w, rfile = self._server_socket_pair()
+        w.sendall(b"\r\nBODY")
+        headers = {}
+        assert parse_header_block(rfile, headers) is None
+        assert headers == {}
+        assert rfile.read(4) == b"BODY"
+        w.close()
+
+    def test_too_many_headers_rejected(self):
+        from seaweedfs_tpu.util.http_server import parse_header_block
+        w, rfile = self._server_socket_pair()
+        w.sendall(b"".join(b"H%d: v\r\n" % i for i in range(150)) +
+                  b"\r\n")
+        assert parse_header_block(rfile, {}, max_headers=100) == "toomany"
+        w.close()
