@@ -161,16 +161,13 @@ class HBaseClient:
             return cell.value
         return None
 
-    def put(self, family: bytes, row: bytes, value: bytes,
-            ttl_sec: int = 0) -> None:
+    def _mutate(self, mutate_type, family: bytes, row: bytes,
+                qualifier_value, ttl_sec: int = 0) -> None:
         mutation = hbase_pb2.MutationProto(
-            row=row, mutate_type=hbase_pb2.MutationProto.PUT,
+            row=row, mutate_type=mutate_type,
             durability=hbase_pb2.MutationProto.ASYNC_WAL,
             column_value=[hbase_pb2.MutationProto.ColumnValue(
-                family=family,
-                qualifier_value=[
-                    hbase_pb2.MutationProto.ColumnValue.QualifierValue(
-                        qualifier=COLUMN, value=value)])])
+                family=family, qualifier_value=[qualifier_value])])
         if ttl_sec > 0:
             # gohbase hrpc.TTL: "_ttl" attribute, int64 milliseconds
             mutation.attribute.add(
@@ -181,21 +178,21 @@ class HBaseClient:
                                            mutation=mutation),
                    hbase_pb2.MutateResponse)
 
+    def put(self, family: bytes, row: bytes, value: bytes,
+            ttl_sec: int = 0) -> None:
+        self._mutate(
+            hbase_pb2.MutationProto.PUT, family, row,
+            hbase_pb2.MutationProto.ColumnValue.QualifierValue(
+                qualifier=COLUMN, value=value),
+            ttl_sec=ttl_sec)
+
     def delete(self, family: bytes, row: bytes) -> None:
-        mutation = hbase_pb2.MutationProto(
-            row=row, mutate_type=hbase_pb2.MutationProto.DELETE,
-            durability=hbase_pb2.MutationProto.ASYNC_WAL,
-            column_value=[hbase_pb2.MutationProto.ColumnValue(
-                family=family,
-                qualifier_value=[
-                    hbase_pb2.MutationProto.ColumnValue.QualifierValue(
-                        qualifier=COLUMN,
-                        delete_type=hbase_pb2.MutationProto.
-                        DELETE_MULTIPLE_VERSIONS)])])
-        self._call("Mutate",
-                   hbase_pb2.MutateRequest(region=self._region,
-                                           mutation=mutation),
-                   hbase_pb2.MutateResponse)
+        self._mutate(
+            hbase_pb2.MutationProto.DELETE, family, row,
+            hbase_pb2.MutationProto.ColumnValue.QualifierValue(
+                qualifier=COLUMN,
+                delete_type=hbase_pb2.MutationProto.
+                DELETE_MULTIPLE_VERSIONS))
 
     def scan(self, family: bytes, start_row: bytes,
              batch: int = 64) -> Iterator[Tuple[bytes, bytes]]:

@@ -238,19 +238,19 @@ class RedisClusterClient:
         node = self._node_for(slot)
         asking = False
         for _ in range(self.MAX_REDIRECTS):
-            conn = self._conn(node)
             try:
+                conn = self._conn(node)
                 if asking:
                     return conn.command_asking(*parts)
                 return conn.command(*parts)
-            except RespConnectionError:
-                # node died mid-conversation: same treatment as a
-                # failed dial — drop, re-learn the map, re-route
+            except OSError:
+                # node unreachable or died mid-conversation
+                # (RespConnectionError is an OSError): drop the
+                # connection, re-learn the map from survivors, re-route
                 self._drop_conn(node)
                 self.refresh_slots()
                 node = self._node_for(slot)
                 asking = False
-                continue
             except RespError as e:
                 msg = str(e)
                 if msg.startswith("MOVED "):
@@ -269,11 +269,6 @@ class RedisClusterClient:
                     asking = True
                     continue
                 raise
-            except OSError:
-                self._drop_conn(node)
-                self.refresh_slots()
-                node = self._node_for(slot)
-                asking = False
         raise RespError(f"redirect loop for slot {slot}")
 
     def masters(self):

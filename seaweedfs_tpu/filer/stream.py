@@ -56,7 +56,7 @@ def fetch_chunk_bytes(lookup: LookupFn, file_id: str,
                 "GET", f"{url}/{file_id}",
                 # raw stored bytes, no server-side decompression
                 headers={"Accept-Encoding": "gzip"}, timeout=60.0)
-        except (OSError, http_client._StaleConnection) as e:
+        except OSError as e:  # incl. http_client._StaleConnection
             err = e
             continue
         if r.status == 200:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import time
 from dataclasses import dataclass, field
 from typing import Iterator, List, Optional
 
@@ -94,7 +95,6 @@ class ChunkedFileReader:
         self._vol_urls: dict = {}  # volume id -> (monotonic ts, [urls])
 
     def _locations(self, fid: str, vid: int) -> List[str]:
-        import time
         from seaweedfs_tpu.operation import operations
         now = time.monotonic()
         cached = self._vol_urls.get(vid)
@@ -115,22 +115,20 @@ class ChunkedFileReader:
         makes the same forget-on-failure trade, server/volume.py)."""
         from seaweedfs_tpu.operation.file_id import parse_fid
         vid = parse_fid(fid).volume_id
-        # _StaleConnection is http_client's connection-level failure
-        # (clean close / RST from a draining server) — exactly the case
-        # failover exists for, so it must be caught alongside OSError
-        conn_errors = (OSError, http_client._StaleConnection)
+        # OSError covers http_client._StaleConnection too (clean close /
+        # RST from a draining server — exactly the case failover is for)
         last_err: Exception = RuntimeError(f"no locations for chunk {fid}")
         for attempt in range(2):
             try:
                 urls = self._locations(fid, vid)
-            except (RuntimeError, *conn_errors) as e:
+            except (RuntimeError, OSError) as e:
                 last_err = e
                 break
             for url in urls:
                 try:
                     r = http_client.request("GET", f"{url}/{fid}",
                                             headers=headers, timeout=60.0)
-                except conn_errors as e:
+                except OSError as e:
                     last_err = e
                     continue
                 if r.status in (200, 206):
