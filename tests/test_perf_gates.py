@@ -64,7 +64,10 @@ def test_ec_kernel_floor():
     dt = time.perf_counter() - t0
     gbps = data.nbytes / (1 << 30) / dt
     if backend == "native":
-        assert gbps >= 0.25, \
+        # native measures 1.2-1.5 GB/s idle but as low as ~0.22 under
+        # heavy concurrent VM load; the numpy fallback is ~0.1 — 0.15
+        # sits between, catching the fallback without flaking on load
+        assert gbps >= 0.15, \
             f"native EC kernel regressed: {gbps:.2f} GB/s"
     else:
         # no native lib in this environment: still catch a pure-python
