@@ -165,6 +165,63 @@ def _combined(encode_gbps: float, rebuild_gbps: float) -> float:
     return 2.0 / (1.0 / encode_gbps + 1.0 / rebuild_gbps)
 
 
+def fleet_batch_sweep(batches=(1, 8, 64)) -> dict:
+    """Cross-volume fused encode vs serial per-volume encode, end to
+    end over real files (the ec/fleet.py scheduler vs a write_ec_files
+    loop). This is a HOST-pipeline measurement — reader pool + fused
+    dispatch + writer thread — so it runs on the host backend by
+    default (override with BENCH_FLEET_BACKEND); the on-device kernel
+    rate is the headline metric above. Wall-clock GB/s of .dat bytes,
+    best-of-N with the two paths alternated so VM load spikes and page-
+    cache writeback stalls hit both — single-shot timings on a shared
+    VM swing ±50%, drowning the fused-vs-serial signal (the same
+    methodology as the test_perf_gates.py fleet floor).
+    """
+    import tempfile
+
+    from seaweedfs_tpu.ec import encoder as enc
+    from seaweedfs_tpu.ec import fleet
+
+    backend = os.environ.get("BENCH_FLEET_BACKEND") or _cpu_backend()
+    vol_mb = int(os.environ.get("BENCH_FLEET_VOL_MB", "8"))
+    repeats = int(os.environ.get("BENCH_FLEET_REPEATS", "5"))
+    vol_bytes = vol_mb << 20
+    block = np.random.default_rng(5).integers(
+        0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    sweep = []
+    for n in batches:
+        with tempfile.TemporaryDirectory() as d:
+            fused_bases, serial_bases = [], []
+            for v in range(n):
+                base = os.path.join(d, f"f{v}")
+                with open(base + ".dat", "wb") as f:
+                    written = 0
+                    while written < vol_bytes:
+                        written += f.write(block[: vol_bytes - written])
+                fused_bases.append(base)
+                sbase = os.path.join(d, f"s{v}")
+                os.link(base + ".dat", sbase + ".dat")
+                serial_bases.append(sbase)
+            serial_s, fused_s = [], []
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                for base in serial_bases:
+                    enc.write_ec_files(base, backend=backend)
+                serial_s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                fleet.fleet_write_ec_files(fused_bases, backend=backend)
+                fused_s.append(time.perf_counter() - t0)
+        total_gb = n * vol_bytes / 1e9
+        sweep.append({
+            "batch_volumes": n,
+            "serial_gbps": round(total_gb / min(serial_s), 3),
+            "fused_gbps": round(total_gb / min(fused_s), 3),
+            "speedup": round(min(serial_s) / min(fused_s), 3),
+        })
+    return {"metric": "ec_fleet_batch_sweep", "unit": "GB/s",
+            "volume_mb": vol_mb, "backend": backend, "sweep": sweep}
+
+
 def main() -> None:
     backend = _cpu_backend()
     enc_m, reb_m = _matrices()
@@ -186,6 +243,13 @@ def main() -> None:
         "baseline_encode_gbps": round(cpu_enc, 3),
         "baseline_rebuild_gbps": round(cpu_reb, 3),
     }))
+    # second line: the cross-volume fleet scheduler sweep (1/8/64
+    # volumes, fused vs serial). Never let it break the headline line.
+    try:
+        print(json.dumps(fleet_batch_sweep()), flush=True)
+    except Exception as e:  # noqa: BLE001 - report, don't fail the bench
+        print(json.dumps({"metric": "ec_fleet_batch_sweep",
+                          "error": str(e)[:300]}), flush=True)
 
 
 if __name__ == "__main__":

@@ -19,4 +19,7 @@ from seaweedfs_tpu.ec.encoder import (
     write_dat_file, write_idx_file_from_ec_index, find_dat_file_size,
     rebuild_ecx_file, shard_file_name, LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE,
 )
+from seaweedfs_tpu.ec.fleet import (
+    fleet_write_ec_files, fleet_rebuild_ec_files,
+)
 from seaweedfs_tpu.ec.ec_volume import EcVolume, EcVolumeShard

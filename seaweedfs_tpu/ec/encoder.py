@@ -15,7 +15,8 @@ systematic), halving the IO the reference's buffer loop does.
 from __future__ import annotations
 
 import os
-from typing import List, Optional
+from collections import deque
+from typing import Deque, List, Optional, Tuple
 
 import numpy as np
 
@@ -87,12 +88,16 @@ def write_ec_files(base_name: str, backend: str = "auto",
 
 
 def _read_padded(f, offset: int, length: int) -> np.ndarray:
+    """One buffer filled in place: readinto() avoids the
+    frombuffer+concatenate double allocation on tail chunks, only the
+    EOF tail is memset, and the result is writable (a read-only
+    frombuffer view forces copies downstream)."""
+    buf = np.empty(length, dtype=np.uint8)
     f.seek(offset)
-    buf = f.read(length)
-    arr = np.frombuffer(buf, dtype=np.uint8)
-    if len(arr) < length:
-        arr = np.concatenate([arr, np.zeros(length - len(arr), dtype=np.uint8)])
-    return arr
+    got = f.readinto(memoryview(buf))
+    if got < length:
+        buf[got:] = 0  # zero padding past EOF
+    return buf
 
 
 # How many encode dispatches may be in flight at once. Depth 2 is classic
@@ -106,7 +111,7 @@ class _EncodePipeline:
     """Bounded in-flight queue of (data, pending-parity, writeback)."""
 
     def __init__(self, depth: int = PIPELINE_DEPTH):
-        self._inflight: List = []
+        self._inflight: Deque[Tuple] = deque()
         self._depth = max(1, depth)
 
     def submit(self, handle, writeback) -> None:
@@ -115,7 +120,7 @@ class _EncodePipeline:
             self._retire_one()
 
     def _retire_one(self) -> None:
-        handle, writeback = self._inflight.pop(0)
+        handle, writeback = self._inflight.popleft()
         writeback(handle.result())
 
     def drain(self) -> None:
@@ -289,9 +294,13 @@ def find_dat_file_size(base_name: str, index_base_name: Optional[str] = None) ->
 def write_dat_file(base_name: str, dat_size: int,
                    large_block: int = LARGE_BLOCK_SIZE,
                    small_block: int = SMALL_BLOCK_SIZE,
-                   chunk: int = DEFAULT_CHUNK) -> None:
+                   chunk: Optional[int] = None,
+                   backend: str = "auto") -> None:
     """Re-interleave .ec00-.ec09 rows back into <base>.dat
-    (reference WriteDatFile, ec_decoder.go:153-195)."""
+    (reference WriteDatFile, ec_decoder.go:153-195). The chunk default
+    follows the backend like encode/rebuild do."""
+    if chunk is None:
+        chunk = default_chunk_for(backend)
     inputs = [open(shard_file_name(base_name, i), "rb")
               for i in range(DATA_SHARDS)]
     try:

@@ -1,0 +1,551 @@
+"""Cross-volume batched EC scheduler — the fleet encoder.
+
+`ec/encoder.py` encodes ONE volume at a time: every chunk is its own
+RS dispatch and a single reader thread feeds the device, so a fleet of
+volumes serializes on dispatch latency and on that one thread's disk
+reads. This module lifts the batch dimension from rows-within-a-volume
+to chunks-ACROSS-volumes (the ROADMAP "sharding, batching, async"
+directive; the BASELINE "cluster-wide ec.encode" shape):
+
+  pack      same-sized row-spans from many volumes fuse into one
+            [B, 10, small] dispatch — the `_encode_small_rows` batch
+            shape — so 64 small volumes cost a handful of dispatches
+            instead of 64 serial ones.
+  feed      a bounded reader pool prefetches spans ahead of the
+            device. Spans are consumed in submission order (round-
+            robin rounds over the volumes), so per-volume row order
+            is preserved by construction while reads overlap compute.
+  dispatch  the jax backend is async already; sync host backends
+            (native/numpy) are lifted to the same handle contract by
+            a small encode pool, so RS compute itself runs multi-core
+            and overlaps the reader and writer threads.
+  retire    a tagged completion queue — the FIFO discipline of
+            `encoder._EncodePipeline`, generalized from one (handle,
+            writeback) pair to per-volume tags — fans each dispatch's
+            parity out to many volumes' .ecNN files. A single retire
+            thread awaits dispatches strictly in submission order and
+            hands every volume's writes to that volume's writer LANE
+            (per-volume FIFO, parallel across volumes), so the ~9
+            bytes written per 10 read don't serialize behind one
+            thread the way the per-volume pipeline's do.
+
+Volumes that need large-row striping (> 10 * large_block bytes) fall
+back to the per-volume `write_ec_files` path; everything else is
+byte-identical to it (uniform small rows — the same on-disk layout
+contract `parallel.sharded_write_ec_files` relies on).
+
+Sharding the fleet across a device mesh (one scheduler per device,
+volumes dealt by size) lives in `parallel/mesh.py`:
+`fleet_write_ec_files_sharded`.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from seaweedfs_tpu.ec import encoder as _encoder
+from seaweedfs_tpu.ec.encoder import (
+    LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, default_chunk_for, shard_file_name)
+from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS, TOTAL_SHARDS
+
+# Reader-pool width: enough to keep several volumes' sequential reads
+# in flight without degrading each stream to fully random IO.
+FLEET_READERS = 4
+
+# Fused dispatches in flight at once — the writer-queue bound, same
+# double-buffering role as encoder.PIPELINE_DEPTH. Peak host memory is
+# ~(depth + 2) fused batches (queued + packing + retiring).
+FLEET_DEPTH = 2
+
+# Encode pool for synchronous host backends: ctypes/numpy release the
+# GIL, so two in-flight fused encodes use two cores — the host-side
+# analogue of the device's async dispatch queue.
+FLEET_ENCODERS = max(2, min(4, os.cpu_count() or 2))
+
+# Writer lanes: each volume's writes stay FIFO on one lane, but lanes
+# run in parallel, so the fleet's file writes (the larger half of the
+# IO: 14 bytes out per 10 in) spread across cores instead of
+# serializing behind a single writer thread.
+FLEET_WRITERS = max(2, min(4, os.cpu_count() or 2))
+
+# Bound on queued writes per lane: with ~chunk-sized spans this caps
+# writer-side buffering at a few spans per lane.
+_LANE_QUEUE = 4
+
+
+class TaggedPipeline:
+    """Tagged completion queue: fused dispatches retire FIFO, writes
+    fan out to per-volume writer lanes.
+
+    One retire thread awaits dispatch handles strictly in submission
+    order — the deque discipline of `encoder._EncodePipeline` — and
+    routes each tagged span's parity write to `tag % lanes`. All of a
+    volume's writes carry the volume's tag, so they land on ONE lane in
+    enqueue order (per-volume FIFO by construction) while different
+    volumes' writes proceed in parallel. Data-shard writes (`write`)
+    need no handle and go straight to the lane from the packing thread;
+    they interleave with parity writes on the lane but touch disjoint
+    files (.ec00-09 vs .ec10-13), so only the per-file order matters —
+    and each file's writes come from a single ordered source.
+    """
+
+    def __init__(self, depth: int = FLEET_DEPTH,
+                 writers: int = FLEET_WRITERS):
+        self._lanes: List["queue.Queue[Optional[Callable]]"] = [
+            queue.Queue(maxsize=_LANE_QUEUE)
+            for _ in range(max(1, writers))]
+        self._retireq: "queue.Queue[Optional[Tuple]]" = \
+            queue.Queue(maxsize=max(1, depth))
+        self._exc: Optional[BaseException] = None
+        self._writers = [
+            threading.Thread(target=self._drain_lane, args=(q,),
+                             name=f"fleet-write-{i}", daemon=True)
+            for i, q in enumerate(self._lanes)]
+        self._retirer = threading.Thread(
+            target=self._retire_loop, name="fleet-retire", daemon=True)
+        for t in self._writers:
+            t.start()
+        self._retirer.start()
+
+    def _lane(self, tag: int) -> "queue.Queue[Optional[Callable]]":
+        return self._lanes[tag % len(self._lanes)]
+
+    def write(self, tag: int, fn: Callable[[], None]) -> None:
+        """Enqueue one ordered write on `tag`'s lane (no handle)."""
+        self._raise_pending()
+        self._lane(tag).put(fn)
+
+    def submit(self, handle,
+               tagged: Sequence[Tuple[int, Callable]]) -> None:
+        """Queue a dispatch: when `handle` resolves (FIFO), span i's
+        output goes to `tagged[i] = (tag, fn)` as `fn(outs[i])` on
+        tag's lane."""
+        self._raise_pending()
+        self._retireq.put((handle, list(tagged)))
+
+    def _retire_loop(self) -> None:
+        while True:
+            item = self._retireq.get()
+            if item is None:
+                return
+            if self._exc is not None:
+                continue  # failed: keep draining, write nothing more
+            handle, tagged = item
+            try:
+                outs = handle.result()
+            except BaseException as e:  # surfaced on submit/drain
+                if self._exc is None:
+                    self._exc = e
+                continue
+            for (tag, fn), out in zip(tagged, outs):
+                self._lane(tag).put(functools.partial(fn, out))
+
+    def _drain_lane(self, q: "queue.Queue[Optional[Callable]]") -> None:
+        while True:
+            fn = q.get()
+            if fn is None:
+                return
+            if self._exc is not None:
+                continue
+            try:
+                fn()
+            except BaseException as e:
+                if self._exc is None:
+                    self._exc = e
+
+    def _raise_pending(self) -> None:
+        # _exc stays latched once set: clearing it here would re-enable
+        # the retire/writer threads after they skipped a failed span,
+        # letting later spans land past a hole in the shard files
+        if self._exc is not None:
+            raise self._exc
+
+    def drain(self) -> None:
+        """Flush every queued write, stop all threads, re-raise the
+        first error (if any). The pipeline is spent afterwards."""
+        self._retireq.put(None)
+        self._retirer.join()
+        for q in self._lanes:
+            q.put(None)
+        for t in self._writers:
+            t.join()
+        self._raise_pending()
+
+
+class _Gathered:
+    """Handle over several in-flight per-span encodes: .result() is the
+    list of per-span outputs, ordered like the spans were packed."""
+
+    def __init__(self, handles):
+        self._handles = handles
+
+    def result(self) -> List[np.ndarray]:
+        return [h.result() for h in self._handles]
+
+
+class _Dispatcher:
+    """Uniform async-handle dispatch over any RS backend.
+
+    jax dispatches are inherently async (the device computes while the
+    host stages IO), so a fused batch is concatenated once and issued
+    as one dispatch — fewer, fuller device slabs. Host backends compute
+    synchronously instead, so each span goes to a small encode pool as
+    its own task (no concatenation copy; the GIL-free native/numpy
+    kernels genuinely run on other cores) and the handles are gathered.
+    Either way .result() yields per-span parity arrays.
+    """
+
+    def __init__(self, rs: ReedSolomon, device=None,
+                 encoders: int = FLEET_ENCODERS):
+        self._rs = rs
+        self._device = device
+        self._pool = None
+        if rs.backend != "jax":
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(1, encoders),
+                thread_name_prefix="fleet-encode")
+
+    def encode(self, arrays: List[np.ndarray]):
+        if self._pool is None:
+            data = arrays[0] if len(arrays) == 1 else \
+                np.concatenate(arrays, axis=0)
+            rows = [a.shape[0] for a in arrays]
+            handle = self._rs.encode_async(data, device=self._device)
+            return _SplitHandle(handle, rows)
+        return _Gathered([self._pool.submit(self._rs.encode, a)
+                          for a in arrays])
+
+    def reconstruct(self, present, missing, arrays: List[np.ndarray]):
+        if self._pool is None:
+            src = np.stack(arrays, axis=0)  # [B, 10, span]
+            handle = self._rs.reconstruct_some_async(
+                present, missing, src, device=self._device)
+            return _UnstackHandle(handle)
+        return _Gathered([self._pool.submit(
+            self._rs.reconstruct_some, present, missing, a)
+            for a in arrays])
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+
+class _SplitHandle:
+    """Adapt one fused async encode handle back to per-span outputs."""
+
+    def __init__(self, handle, rows: List[int]):
+        self._handle = handle
+        self._rows = rows
+
+    def result(self) -> List[np.ndarray]:
+        out = self._handle.result()
+        if len(self._rows) == 1:
+            return [out]
+        parts, row = [], 0
+        for r in self._rows:
+            parts.append(out[row:row + r])
+            row += r
+        return parts
+
+
+class _UnstackHandle:
+    """Adapt one fused [B, ...] reconstruct handle to per-item outputs."""
+
+    def __init__(self, handle):
+        self._handle = handle
+
+    def result(self) -> List[np.ndarray]:
+        out = self._handle.result()
+        return [out[i] for i in range(out.shape[0])]
+
+
+class _VolState:
+    __slots__ = ("base", "dat_size", "n_rows", "tag")
+
+    def __init__(self, base: str, dat_size: int, n_rows: int, tag: int = 0):
+        self.base = base
+        self.dat_size = dat_size
+        self.n_rows = n_rows
+        self.tag = tag  # writer-lane key: all this volume's writes
+        #                 share it, so they stay FIFO on one lane
+
+
+def _append_rows(base: str, shard_id: int,
+                 rows: Sequence[np.ndarray]) -> None:
+    """Append C-contiguous row slices to one shard file: the slices go
+    straight to the (buffered) file object — no ascontiguousarray /
+    tobytes staging copies on the write path."""
+    with open(shard_file_name(base, shard_id), "ab") as f:
+        for r in rows:
+            f.write(r)
+
+
+def _round_robin_spans(vols: List[_VolState], span_rows: int):
+    """Yield (vol, row0, rows) in rounds over the volumes: round r
+    hands out rows [r*span, (r+1)*span) of every volume still alive.
+    Submission order == pack order == per-volume row order."""
+    pending = [(v, 0) for v in vols if v.n_rows > 0]
+    while pending:
+        nxt = []
+        for v, row0 in pending:
+            rows = min(span_rows, v.n_rows - row0)
+            yield v, row0, rows
+            if row0 + rows < v.n_rows:
+                nxt.append((v, row0 + rows))
+        pending = nxt
+
+
+def _read_span(base: str, row0: int, rows: int,
+               row_bytes: int, small_block: int) -> np.ndarray:
+    """Rows [row0, row0+rows) of one volume as [rows, 10, small],
+    zero-padded past EOF — one sequential read per span (the same
+    readinto primitive as encoder._read_padded)."""
+    with open(base + ".dat", "rb") as f:
+        buf = _encoder._read_padded(f, row0 * row_bytes, rows * row_bytes)
+    return buf.reshape(rows, DATA_SHARDS, small_block)
+
+
+def _write_data_shards(base: str, arr: np.ndarray) -> None:
+    for i in range(DATA_SHARDS):
+        _append_rows(base, i, [arr[r, i] for r in range(arr.shape[0])])
+
+
+def _write_parity_span(base: str, seg: np.ndarray) -> None:
+    """One span's parity [rows, 4, small] -> append to .ec10-.ec13."""
+    for p in range(seg.shape[1]):
+        _append_rows(base, DATA_SHARDS + p,
+                     [seg[r, p] for r in range(seg.shape[0])])
+
+
+def fleet_write_ec_files(base_names: Sequence[str], backend: str = "auto",
+                         large_block: int = LARGE_BLOCK_SIZE,
+                         small_block: int = SMALL_BLOCK_SIZE,
+                         chunk: Optional[int] = None,
+                         readers: int = FLEET_READERS,
+                         depth: int = FLEET_DEPTH,
+                         encoders: int = FLEET_ENCODERS,
+                         device=None) -> None:
+    """Generate .ec00-.ec13 for MANY volumes, fusing chunks across
+    volumes into shared RS dispatches.
+
+    Byte-identical to running `write_ec_files` per volume: small-row
+    volumes ride the fused scheduler; oversized ones (large-row
+    striping) fall back to the per-volume path. `device` pins the jax
+    dispatches of this scheduler to one chip (see
+    parallel.fleet_write_ec_files_sharded).
+    """
+    if chunk is None:
+        chunk = default_chunk_for(backend)
+    fleet: List[str] = []
+    for base in base_names:
+        if os.path.getsize(base + ".dat") > DATA_SHARDS * large_block:
+            _encoder.write_ec_files(base, backend=backend,
+                                    large_block=large_block,
+                                    small_block=small_block, chunk=chunk)
+        else:
+            fleet.append(base)
+    if not fleet:
+        return
+    row_bytes = DATA_SHARDS * small_block
+    vols = []
+    for tag, base in enumerate(fleet):
+        size = os.path.getsize(base + ".dat")
+        vols.append(_VolState(base, size, -(-size // row_bytes), tag))
+        for i in range(TOTAL_SHARDS):  # create/truncate all 14 outputs
+            open(shard_file_name(base, i), "wb").close()
+    alive = [v for v in vols if v.n_rows > 0]
+    if not alive:
+        return  # all empty: 14 empty shard files each, same as serial
+    # One fused dispatch ≈ `chunk` bytes of data rows; span size is the
+    # per-volume slice of it, so a full round across the fleet packs
+    # into one dispatch (a single volume degrades to the serial shape).
+    batch_rows = max(1, chunk // row_bytes)
+    span_rows = max(1, batch_rows // len(alive))
+    spans_per_batch = -(-batch_rows // span_rows)
+    prefetch = max(readers, 2 * spans_per_batch)
+
+    dispatcher = _Dispatcher(ReedSolomon(backend=backend), device=device,
+                             encoders=encoders)
+    pool = ThreadPoolExecutor(max_workers=max(1, readers),
+                              thread_name_prefix="fleet-read")
+    pipe = TaggedPipeline(depth=depth)
+    gen = _round_robin_spans(alive, span_rows)
+    inflight: deque = deque()
+
+    def fill() -> None:
+        while len(inflight) < prefetch:
+            nxt = next(gen, None)
+            if nxt is None:
+                return
+            v, row0, rows = nxt
+            inflight.append((v, rows, pool.submit(
+                _read_span, v.base, row0, rows, row_bytes, small_block)))
+
+    def flush(pack: List[Tuple[_VolState, int, np.ndarray]]) -> None:
+        handle = dispatcher.encode([a for _, _, a in pack])
+        # data shards need no parity: straight to each volume's lane
+        # (enqueued here, in pack order, so per-volume FIFO holds)
+        for v, _, arr in pack:
+            pipe.write(v.tag, functools.partial(
+                _write_data_shards, v.base, arr))
+        pipe.submit(handle, [
+            (v.tag, functools.partial(_write_parity_span, v.base))
+            for v, _, _ in pack])
+
+    try:
+        fill()
+        pack: List[Tuple[_VolState, int, np.ndarray]] = []
+        acc = 0
+        while inflight:
+            v, rows, fut = inflight.popleft()
+            pack.append((v, rows, fut.result()))
+            acc += rows
+            fill()
+            if acc >= batch_rows or not inflight:
+                flush(pack)
+                pack, acc = [], 0
+    finally:
+        pool.shutdown(wait=True)
+        try:
+            pipe.drain()  # may re-raise the latched pipeline error
+        finally:
+            dispatcher.close()
+
+
+# --- fleet rebuild -----------------------------------------------------------
+
+def fleet_rebuild_ec_files(base_names: Sequence[str], backend: str = "auto",
+                           chunk: Optional[int] = None,
+                           wanted: Optional[List[int]] = None,
+                           readers: int = FLEET_READERS,
+                           depth: int = FLEET_DEPTH,
+                           encoders: int = FLEET_ENCODERS,
+                           device=None) -> Dict[str, List[int]]:
+    """Cross-volume batched `rebuild_ec_files`.
+
+    Volumes sharing a (present, missing) signature share one decode
+    matrix, so their shard chunks fuse into single [B, 10, span]
+    reconstruct dispatches — the rebuild-side twin of
+    `fleet_write_ec_files`. Tail spans are zero-padded to the bucket
+    width (GF maps send 0 to 0) and trimmed on writeback. Returns
+    {base_name: rebuilt shard ids} (empty list where nothing was
+    missing).
+    """
+    if chunk is None:
+        chunk = default_chunk_for(backend)
+    rebuilt: Dict[str, List[int]] = {}
+    groups: Dict[Tuple[Tuple[int, ...], Tuple[int, ...]],
+                 List[Tuple[str, int]]] = {}
+    for base in base_names:
+        present = [i for i in range(TOTAL_SHARDS)
+                   if os.path.exists(shard_file_name(base, i))]
+        missing = [i for i in
+                   (range(TOTAL_SHARDS) if wanted is None else wanted)
+                   if i not in present]
+        rebuilt[base] = missing
+        if not missing:
+            continue
+        if len(present) < DATA_SHARDS:
+            raise ValueError(
+                f"cannot rebuild {base}: only {len(present)} shards present")
+        shard_size = os.path.getsize(shard_file_name(base, present[0]))
+        groups.setdefault((tuple(present), tuple(missing)),
+                          []).append((base, shard_size))
+    for (present, missing), members in groups.items():
+        _fleet_rebuild_group(list(present), list(missing), members, backend,
+                             chunk, readers, depth, encoders, device)
+    return rebuilt
+
+
+def _write_rebuilt_span(base: str, missing: List[int], valid: int,
+                        out: np.ndarray) -> None:
+    """One span's rebuilt shards [len(missing), span] -> append the
+    valid prefix of each row to its .ecNN file."""
+    for row, sid in enumerate(missing):
+        _append_rows(base, sid, [out[row, :valid]])
+
+
+def _read_present_span(base: str, present: List[int], shard_size: int,
+                       offset: int, span: int) -> np.ndarray:
+    """[10, span] slice at `offset` of the first 10 present shards,
+    zero-padded past shard end."""
+    src = np.zeros((DATA_SHARDS, span), dtype=np.uint8)
+    want = min(span, max(shard_size - offset, 0))
+    if want > 0:
+        for row, sid in enumerate(present[:DATA_SHARDS]):
+            with open(shard_file_name(base, sid), "rb") as f:
+                f.seek(offset)
+                f.readinto(memoryview(src[row])[:want])
+    return src
+
+
+def _fleet_rebuild_group(present: List[int], missing: List[int],
+                         members: List[Tuple[str, int]], backend: str,
+                         chunk: int, readers: int, depth: int,
+                         encoders: int, device) -> None:
+    for base, _ in members:
+        for sid in missing:
+            open(shard_file_name(base, sid), "wb").close()
+    # Uniform span width so spans from different volumes stack into one
+    # [B, 10, span] dispatch of ~chunk bytes per shard row.
+    span = max(1, chunk // len(members))
+    vols = [(_VolState(base, size, -(-size // span), tag), size)
+            for tag, (base, size) in enumerate(members)]
+
+    def gen_spans():
+        for v, row0, rows in _round_robin_spans([v for v, _ in vols], 1):
+            yield v, row0 * span
+
+    dispatcher = _Dispatcher(ReedSolomon(backend=backend), device=device,
+                             encoders=encoders)
+    pool = ThreadPoolExecutor(max_workers=max(1, readers),
+                              thread_name_prefix="fleet-read")
+    pipe = TaggedPipeline(depth=depth)
+    gen = gen_spans()
+    inflight: deque = deque()
+    per_batch = len(members)
+    prefetch = max(readers, 2 * per_batch)
+
+    def fill() -> None:
+        while len(inflight) < prefetch:
+            nxt = next(gen, None)
+            if nxt is None:
+                return
+            v, offset = nxt
+            inflight.append((v, offset, pool.submit(
+                _read_present_span, v.base, present, v.dat_size,
+                offset, span)))
+
+    def flush(pack) -> None:
+        handle = dispatcher.reconstruct(present, missing,
+                                        [a for _, _, a in pack])
+        pipe.submit(handle, [
+            (v.tag, functools.partial(_write_rebuilt_span, v.base,
+                                      missing,
+                                      min(span, v.dat_size - offset)))
+            for v, offset, _ in pack])
+
+    try:
+        fill()
+        pack = []
+        while inflight:
+            item = inflight.popleft()
+            pack.append((item[0], item[1], item[2].result()))
+            fill()
+            if len(pack) >= per_batch or not inflight:
+                flush(pack)
+                pack = []
+    finally:
+        pool.shutdown(wait=True)
+        try:
+            pipe.drain()  # may re-raise the latched pipeline error
+        finally:
+            dispatcher.close()

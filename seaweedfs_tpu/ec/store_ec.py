@@ -11,9 +11,9 @@ stays EC-agnostic (the ec package plugs into DiskLocation.ec_volumes).
 from __future__ import annotations
 
 import os
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
-from seaweedfs_tpu.ec import encoder
+from seaweedfs_tpu.ec import encoder, fleet
 from seaweedfs_tpu.ec.ec_volume import EcVolume, EcShardNotFound
 from seaweedfs_tpu.ec.shard_bits import TOTAL_SHARDS
 from seaweedfs_tpu.ops.rs_code import ReedSolomon
@@ -69,6 +69,32 @@ def generate_ec_shards(store: Store, vid: int, backend: str = "auto") -> str:
     encoder.write_ec_files(base, backend=backend)
     encoder.write_sorted_file_from_idx(base)
     return base
+
+
+def generate_ec_shards_batch(store: Store, vids: Sequence[int],
+                             backend: str = "auto") -> Dict[int, str]:
+    """VolumeEcShardsGenerate for MANY volumes in one fused pass.
+
+    Every volume is frozen (read-only + sync) up front, then a single
+    fleet scheduler (ec/fleet.py) packs chunks from all of them into
+    shared RS dispatches. Shard bytes are identical to calling
+    generate_ec_shards per volume. Returns {vid: base_name}.
+    """
+    vols = []
+    for vid in vids:  # validate the whole list BEFORE freezing any —
+        v = store.find_volume(vid)  # a bad vid must not strand earlier
+        if v is None:               # volumes read-only with no shards
+            raise NeedleError(f"volume {vid} not found for ec encode")
+        vols.append((vid, v))
+    bases: Dict[int, str] = {}
+    for vid, v in vols:
+        v.read_only = True
+        v.sync()
+        bases[vid] = v.file_name()
+    fleet.fleet_write_ec_files(list(bases.values()), backend=backend)
+    for base in bases.values():
+        encoder.write_sorted_file_from_idx(base)
+    return bases
 
 
 def rebuild_ec_shards(store: Store, vid: int, collection: Optional[str] = None,
@@ -194,7 +220,7 @@ def ec_shards_to_volume(store: Store, vid: int, collection: str = "",
     encoder.rebuild_ec_files(base, backend=backend,
                              wanted=list(range(encoder.DATA_SHARDS)))
     dat_size = encoder.find_dat_file_size(base)
-    encoder.write_dat_file(base, dat_size,
+    encoder.write_dat_file(base, dat_size, backend=backend,
                            large_block=large_block, small_block=small_block)
     encoder.write_idx_file_from_ec_index(base)
     from seaweedfs_tpu.storage.volume import Volume

@@ -120,13 +120,15 @@ class ReedSolomon:
             raise ValueError(f"expected {self.data_shards} data shards")
         return self._apply(self.matrix[self.data_shards:], data)
 
-    def encode_async(self, data: np.ndarray):
+    def encode_async(self, data: np.ndarray, device=None):
         """Pipelined encode: returns a handle with .result() -> parity.
 
         On the jax backend the dispatch is issued immediately and the
         device computes while the caller does host IO; other backends
         compute synchronously and return a pre-resolved handle, so
-        pipeline-structured callers work uniformly.
+        pipeline-structured callers work uniformly. `device` pins the
+        dispatch to one jax device (the fleet scheduler runs one
+        scheduler per device); ignored by host backends.
         """
         data = np.asarray(data, dtype=np.uint8)
         if data.shape[-2] != self.data_shards:
@@ -134,7 +136,7 @@ class ReedSolomon:
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_kernel
             return rs_kernel.apply_matrix_async(
-                self.matrix[self.data_shards:], data)
+                self.matrix[self.data_shards:], data, device=device)
         return _Resolved(self._apply(self.matrix[self.data_shards:], data))
 
     def encode_all(self, data: np.ndarray) -> np.ndarray:
@@ -171,19 +173,20 @@ class ReedSolomon:
 
     def reconstruct_some_async(self, present: Sequence[int],
                                wanted: Sequence[int],
-                               shard_data: np.ndarray):
+                               shard_data: np.ndarray, device=None):
         """Pipelined reconstruct_some: returns a handle with .result().
 
-        Same contract as encode_async — on the jax backend the dispatch
-        is in flight while the caller overlaps host IO (the rebuild
-        pipeline in ec/encoder.py rides this)."""
+        Same contract as encode_async (including `device` pinning) — on
+        the jax backend the dispatch is in flight while the caller
+        overlaps host IO (the rebuild pipelines in ec/encoder.py and
+        ec/fleet.py ride this)."""
         present = tuple(present)
         m = self._decode_matrix(present[: self.data_shards], tuple(wanted))
         shard_data = np.asarray(shard_data, dtype=np.uint8)
         if self.backend == "jax":
             from seaweedfs_tpu.ops import rs_kernel
             return rs_kernel.apply_matrix_async(
-                m, shard_data[..., : self.data_shards, :])
+                m, shard_data[..., : self.data_shards, :], device=device)
         return _Resolved(self._apply(m, shard_data[..., : self.data_shards, :]))
 
     def reconstruct(self, shards: list[Optional[np.ndarray]],

@@ -143,15 +143,23 @@ class PendingApply:
         return out
 
 
-def apply_matrix_async(matrix: np.ndarray, shards) -> PendingApply:
+def apply_matrix_async(matrix: np.ndarray, shards,
+                       device=None) -> PendingApply:
     """Dispatch apply_matrix without waiting for the device.
 
     Returns a PendingApply whose .result() blocks. Between submit and
     fetch the host is free to read the next slab from disk / write the
     previous one — the caller-visible half of the streaming pipeline.
+
+    `device` pins the whole dispatch to ONE jax device instead of the
+    default placement / lane sharding: the fleet scheduler
+    (ec/fleet.py) runs one scheduler per device, so each scheduler's
+    slabs must land on its own chip.
     """
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     m2 = _m2_device(matrix.tobytes(), matrix.shape[0], matrix.shape[1])
+    if device is not None:
+        m2 = jax.device_put(m2, device)
     shards = np.asarray(shards, dtype=np.uint8)
     batch_shape = shards.shape[:-2]
     s, n = shards.shape[-2:]
@@ -163,7 +171,7 @@ def apply_matrix_async(matrix: np.ndarray, shards) -> PendingApply:
             np.moveaxis(shards.reshape((-1, s, n)), 1, 0)).reshape(s, -1)
     else:
         flat = shards
-    parts = _submit_slabs(m2, flat)
+    parts = _submit_slabs(m2, flat, device=device)
     return PendingApply(parts, o, flat.shape[1], batch_shape, n)
 
 
@@ -200,10 +208,10 @@ def _lane_sharding():
     return NamedSharding(mesh, PartitionSpec(None, "lanes"))
 
 
-def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray):
+def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray, device=None):
     """Issue one async dispatch per power-of-two slab; no fetches."""
     s, n = flat.shape
-    sharding = _lane_sharding()
+    sharding = None if device is not None else _lane_sharding()
     parts = []
     pos = 0
     while pos < n:
@@ -216,7 +224,9 @@ def _submit_slabs(m2: jnp.ndarray, flat: np.ndarray):
             padded = np.zeros((s, slab), dtype=np.uint8)
             padded[:, :want] = chunk
             chunk = padded
-        if sharding is not None and slab % sharding.mesh.size == 0:
+        if device is not None:
+            x = jax.device_put(np.ascontiguousarray(chunk), device)
+        elif sharding is not None and slab % sharding.mesh.size == 0:
             # device_put the HOST array straight onto the sharding:
             # each device receives only its lane slice (going through
             # device 0 first would double the interconnect traffic)

@@ -26,7 +26,10 @@ from seaweedfs_tpu.parallel.mesh import (
     ec_pipeline_step,
     rotate_shards,
     volume_shard_matrix,
+    round_robin_by_size,
+    fleet_write_ec_files_sharded,
 )
 
 __all__ = ["make_mesh", "sharded_encode", "sharded_write_ec_files",
-           "ec_pipeline_step", "rotate_shards", "volume_shard_matrix"]
+           "ec_pipeline_step", "rotate_shards", "volume_shard_matrix",
+           "round_robin_by_size", "fleet_write_ec_files_sharded"]

@@ -21,7 +21,9 @@ chips instead of gRPC-connected hosts.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Sequence, Tuple
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -252,3 +254,77 @@ def sharded_write_ec_files(mesh: Mesh, base_names: Sequence[str],
                     with open(shard_file_name(base, DATA_SHARDS + p),
                               "ab") as f:
                         f.write(parity[v, p, : v_lanes].tobytes())
+
+
+# -- fleet scheduler sharded over the devices (ec/fleet.py) ------------------
+
+def round_robin_by_size(base_names: Sequence[str],
+                        n_shards: int) -> List[List[str]]:
+    """Deal volumes to `n_shards` buckets, largest .dat first, each to
+    the currently lightest bucket (the sorted round-robin / LPT deal):
+    shard byte-loads stay within one volume of each other, so the
+    per-device fleet schedulers finish together instead of the fleet
+    waiting on one device that drew all the big volumes."""
+    sizes = {b: os.path.getsize(b + ".dat") for b in base_names}
+    order = sorted(base_names, key=lambda b: (-sizes[b], b))
+    buckets: List[List[str]] = [[] for _ in range(max(1, n_shards))]
+    loads = [0] * len(buckets)
+    for b in order:
+        i = loads.index(min(loads))
+        buckets[i].append(b)
+        loads[i] += sizes[b] or 1  # empty volumes still cost a slot
+    return buckets
+
+
+def fleet_write_ec_files_sharded(base_names: Sequence[str],
+                                 devices: Optional[Sequence] = None,
+                                 mesh: Optional[Mesh] = None,
+                                 backend: str = "jax",
+                                 **fleet_kw) -> None:
+    """Shard the fleet across the device mesh: ONE fleet scheduler per
+    device, each pinning its fused dispatches to its own chip, with the
+    volume list dealt round-robin by size so the shards finish
+    together. This is the BASELINE "256 volumes pmapped over v5e-8"
+    shape expressed as independent per-chip schedulers — encode has no
+    cross-volume math, so schedulers share nothing but the disk.
+
+    Host backends get the same volume sharding (per-scheduler reader
+    and encode pools still overlap) with no device pinning; their
+    default shard count comes from the core count, not jax.devices()
+    — a CPU-only host reports one jax device, which would collapse
+    the fleet to a single scheduler (and initialize jax for nothing).
+    """
+    from seaweedfs_tpu.ec import fleet as fleet_mod
+
+    if not base_names:
+        return
+    if devices is None:
+        if backend == "jax":
+            devices = (list(mesh.devices.flat) if mesh is not None
+                       else jax.devices())
+        else:
+            # each scheduler runs its own reader/encode/writer pools,
+            # so a couple of schedulers saturate a host; scale gently
+            devices = [None] * max(1, min(len(base_names),
+                                          (os.cpu_count() or 2) // 2))
+    shards = [s for s in round_robin_by_size(base_names, len(devices)) if s]
+    if backend != "jax":
+        devices = [None] * len(shards)
+    errors: List[BaseException] = []
+
+    def run(names: List[str], dev) -> None:
+        try:
+            fleet_mod.fleet_write_ec_files(names, backend=backend,
+                                           device=dev, **fleet_kw)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(names, dev),
+                                name=f"fleet-shard-{i}")
+               for i, (names, dev) in enumerate(zip(shards, devices))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]

@@ -620,10 +620,18 @@ class VolumeServer:
     # -- gRPC: erasure coding --------------------------------------------------
 
     def VolumeEcShardsGenerate(self, request, context):
+        vids = list(request.volume_ids) or [request.volume_id]
         try:
-            store_ec.generate_ec_shards(
-                self.store, request.volume_id,
-                backend=request.encoder or self.ec_encoder)
+            if len(vids) == 1:
+                store_ec.generate_ec_shards(
+                    self.store, vids[0],
+                    backend=request.encoder or self.ec_encoder)
+            else:
+                # cross-volume fused encode: one fleet scheduler packs
+                # all the volumes' chunks into shared RS dispatches
+                store_ec.generate_ec_shards_batch(
+                    self.store, vids,
+                    backend=request.encoder or self.ec_encoder)
         except NeedleError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return volume_server_pb2.VolumeEcShardsGenerateResponse()

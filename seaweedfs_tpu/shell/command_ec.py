@@ -18,11 +18,13 @@ from seaweedfs_tpu.shell import command, ec_common
 from seaweedfs_tpu.shell.command_env import CommandEnv, EcNode
 
 
-@command("ec.encode", "erasure-code one volume (or all full ones) as "
-                      "RS(10,4) shards spread over the cluster")
+@command("ec.encode", "erasure-code volumes (one, a list, or all full "
+                      "ones) as RS(10,4) shards spread over the cluster")
 def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
     p = argparse.ArgumentParser(prog="ec.encode")
-    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-volumeId", type=parse_vid_list, default=[],
+                   help="volume id, or a comma-separated list "
+                        "(-volumeId=3,4,5) encoded in one invocation")
     p.add_argument("-collection", default="")
     p.add_argument("-fullPercent", type=float, default=95.0)
     p.add_argument("-quietFor", default="0", type=parse_duration,
@@ -32,7 +34,7 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
     args = p.parse_args(argv)
     encoder = {"tpu": "jax"}.get(args.encoder, args.encoder)
 
-    vids = [args.volumeId] if args.volumeId else \
+    vids = args.volumeId or \
         _collect_full_volumes(env, args.collection, args.fullPercent,
                               args.quietFor)
     if not vids:
@@ -44,12 +46,79 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
         collections = {v: replicas[0].info.collection
                        for v, replicas in
                        env.collect_volume_replicas().items()}
+        # Resolve replicas up front and group volumes by (generator
+        # node, collection) — the generator is the first replica
+        # holder: each group goes out as ONE VolumeEcShardsGenerate
+        # RPC, so the server fuses the whole group's chunks into
+        # shared RS dispatches (store_ec.generate_ec_shards_batch ->
+        # ec/fleet.py) instead of encoding the volumes serially.
+        resolved: Dict[int, List[str]] = {}  # vid -> replicas
+        groups: Dict[tuple, List[int]] = {}
         for vid in vids:
-            _do_ec_encode(env, vid,
-                          args.collection or collections.get(vid, ""),
-                          encoder, out)
+            collection = args.collection or collections.get(vid, "")
+            replicas = env.lookup(vid, collection)
+            if not replicas:
+                out.write(f"volume {vid}: no locations\n")
+                continue
+            resolved[vid] = replicas
+            groups.setdefault((replicas[0], collection), []).append(vid)
+        failures: List[str] = []
+        for source, collection in sorted(groups):
+            group = groups[(source, collection)]
+            # 1.+2. freeze writes on every replica of every volume,
+            # then one fused generate for the whole group; if either
+            # step fails, unfreeze everything frozen so far (best
+            # effort — a volume never frozen tolerates MarkWritable)
+            # so the group keeps taking writes and later groups still
+            # get their chance
+            try:
+                for vid in group:
+                    for url in resolved[vid]:
+                        env.volume_server(url).VolumeMarkReadonly(
+                            volume_server_pb2.VolumeMarkReadonlyRequest(
+                                volume_id=vid))
+                env.volume_server(source).VolumeEcShardsGenerate(
+                    volume_server_pb2.VolumeEcShardsGenerateRequest(
+                        volume_id=group[0], volume_ids=group,
+                        collection=collection, encoder=encoder))
+            except Exception as e:
+                failures.append(f"volumes {group}: generate failed: {e}")
+                out.write(failures[-1] + "\n")
+                for vid in group:
+                    for url in resolved[vid]:
+                        try:
+                            env.volume_server(url).VolumeMarkWritable(
+                                volume_server_pb2.VolumeMarkWritableRequest(
+                                    volume_id=vid))
+                        except Exception:
+                            pass  # node down: nothing left to unfreeze
+                continue
+            for vid in group:
+                out.write(f"volume {vid}: generated 14 shards "
+                          f"on {source}\n")
+            # 3./4. spread + retire the originals per volume; one
+            # volume's failure must not strand the rest of its group
+            # frozen with unspread shards
+            for vid in group:
+                try:
+                    _spread_and_retire(env, vid, collection, source,
+                                       resolved[vid], out)
+                except Exception as e:
+                    failures.append(f"volume {vid}: {e}")
+                    out.write(f"volume {vid}: ec.encode failed: {e}\n")
+        if failures:
+            raise RuntimeError("ec.encode failed: " +
+                               "; ".join(failures))
     finally:
         env.release_lock()
+
+
+def parse_vid_list(text: str) -> List[int]:
+    """'-volumeId=7' or '-volumeId=3,4,5' -> volume ids; 0/'' means
+    "unset" (fall back to collecting full volumes), matching the old
+    single-id flag."""
+    vids = [int(t) for t in (text or "").split(",") if t.strip()]
+    return [] if vids == [0] else vids
 
 
 def parse_duration(text: str) -> float:
@@ -93,27 +162,13 @@ def _collect_full_volumes(env: CommandEnv, collection: str,
     return sorted(vids)
 
 
-def _do_ec_encode(env: CommandEnv, vid: int, collection: str,
-                  encoder: str, out) -> None:
-    replicas = env.lookup(vid, collection)
-    if not replicas:
-        out.write(f"volume {vid}: no locations\n")
-        return
-    # 1. freeze writes on every replica
-    for url in replicas:
-        env.volume_server(url).VolumeMarkReadonly(
-            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
-    # 2. generate all 14 shards on the first replica holder
-    source = replicas[0]
-    env.volume_server(source).VolumeEcShardsGenerate(
-        volume_server_pb2.VolumeEcShardsGenerateRequest(
-            volume_id=vid, collection=collection, encoder=encoder))
-    out.write(f"volume {vid}: generated 14 shards on {source}\n")
-    # 3. spread by free slots
+def _spread_and_retire(env: CommandEnv, vid: int, collection: str,
+                       source: str, replicas: List[str], out) -> None:
+    """Steps 3-4 of ec.encode for one volume whose 14 shards already
+    sit on `source`: spread by free slots, then drop the original."""
     nodes = env.collect_ec_nodes()
     plan = ec_common.balanced_distribution(nodes)
     _spread_ec_shards(env, vid, collection, source, plan, out)
-    # 4. the original volume is now redundant
     for url in replicas:
         env.volume_server(url).VolumeDelete(
             volume_server_pb2.VolumeDeleteRequest(volume_id=vid))

@@ -168,13 +168,15 @@ class Histogram(_Metric):
             items = list(self._children.items())
         for values, child in items:
             for b, c in zip(child.buckets, child.counts):
+                le = 'le="%s"' % b
                 lines.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(self.label_names, values, f'le=\"{b}\"')}"
+                    f"{_fmt_labels(self.label_names, values, le)}"
                     f" {c}")
+            le_inf = 'le="+Inf"'
             lines.append(
                 f"{self.name}_bucket"
-                f"{_fmt_labels(self.label_names, values, 'le=\"+Inf\"')}"
+                f"{_fmt_labels(self.label_names, values, le_inf)}"
                 f" {child.count}")
             lines.append(f"{self.name}_sum"
                          f"{_fmt_labels(self.label_names, values)}"

@@ -50,25 +50,34 @@ class Cluster:
             pulse_seconds=pulse_seconds)
         self.master.start()
         self.volume_servers: List[VolumeServer] = []
-        for i in range(n_volume_servers):
-            d = tmp_path / f"vol{i}"
-            d.mkdir(parents=True, exist_ok=True)
-            vs = VolumeServer(
-                master_url=self.master.url, directories=[str(d)],
-                port=free_port_pair(),
-                max_volume_counts=[volumes_per_server],
-                pulse_seconds=pulse_seconds, ec_encoder=ec_encoder)
-            vs.start()
-            self.volume_servers.append(vs)
         self.filer = None
-        if with_filer:
-            from seaweedfs_tpu.server.filer import FilerServer
-            kw = dict(meta_dir=str(tmp_path / "filer"))
-            kw.update(filer_kwargs or {})
-            self.filer = FilerServer(
-                master_url=self.master.url, port=free_port_pair(), **kw)
-            self.filer.start()
-        self.wait_for_nodes(n_volume_servers)
+        try:
+            for i in range(n_volume_servers):
+                d = tmp_path / f"vol{i}"
+                d.mkdir(parents=True, exist_ok=True)
+                vs = VolumeServer(
+                    master_url=self.master.url, directories=[str(d)],
+                    port=free_port_pair(),
+                    max_volume_counts=[volumes_per_server],
+                    pulse_seconds=pulse_seconds, ec_encoder=ec_encoder)
+                vs.start()
+                self.volume_servers.append(vs)
+            if with_filer:
+                from seaweedfs_tpu.server.filer import FilerServer
+                kw = dict(meta_dir=str(tmp_path / "filer"))
+                kw.update(filer_kwargs or {})
+                self.filer = FilerServer(
+                    master_url=self.master.url, port=free_port_pair(), **kw)
+                self.filer.start()
+            self.wait_for_nodes(n_volume_servers)
+        except BaseException:
+            # A half-built cluster must not leak live servers: no
+            # fixture teardown runs when __init__ raises (filer import
+            # failure, node-registration timeout), and the leaked grpc
+            # handler threads then block interpreter exit until the
+            # suite's outer timeout kills it.
+            self.stop()
+            raise
 
     def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout

@@ -354,6 +354,81 @@ def test_ec_encode_jax_backend_through_rpc(cluster):
                 assert r.read() == d
 
 
+def test_shell_ec_encode_multiple_volume_ids(cluster):
+    """ec.encode -volumeId=a,b: one shell invocation erasure-codes
+    several volumes end to end — both volumes end up as spread EC
+    shards and every blob still reads back through the EC path."""
+    from seaweedfs_tpu.shell import Shell
+
+    datas = {c: [os.urandom(1024) for _ in range(4)]
+             for c in ("flta", "fltb")}
+    fids = {c: [cluster.upload(d, collection=c) for d in ds]
+            for c, ds in datas.items()}
+    va = parse_fid(fids["flta"][0]).volume_id
+    vb = parse_fid(fids["fltb"][0]).volume_id
+    assert va != vb
+
+    out = Shell(cluster.master.url).run_command(
+        f"ec.encode -volumeId={va},{vb} -encoder numpy")
+    assert f"volume {va}: ec.encode done" in out
+    assert f"volume {vb}: ec.encode done" in out
+    cluster.wait_for(
+        lambda: cluster.master.topo.lookup_ec(va) and
+        cluster.master.topo.lookup_ec(vb),
+        what="both volumes' ec shards in topology")
+    # the originals are gone, the EC path serves every blob
+    cluster.wait_for(lambda: not cluster.master.topo.lookup(va) and
+                     not cluster.master.topo.lookup(vb),
+                     what="original volumes retired")
+    for c in datas:
+        for fid, d in zip(fids[c], datas[c]):
+            if parse_fid(fid).volume_id in (va, vb):
+                with cluster.fetch(fid) as r:
+                    assert r.read() == d
+
+
+def test_shell_ec_encode_fuses_one_rpc_per_server(tmp_path, monkeypatch):
+    """Volumes whose shards generate on the same node must go out as
+    ONE VolumeEcShardsGenerate RPC and run through the fused
+    generate_ec_shards_batch — the cross-volume scheduler is only real
+    if the cluster wiring actually reaches it."""
+    from seaweedfs_tpu.shell import Shell
+
+    calls = []
+    orig = store_ec.generate_ec_shards_batch
+
+    def spy(store, vids, backend="auto"):
+        calls.append(sorted(vids))
+        return orig(store, vids, backend=backend)
+
+    monkeypatch.setattr(store_ec, "generate_ec_shards_batch", spy)
+    c = Cluster(tmp_path, n_volume_servers=1, volumes_per_server=8,
+                ec_encoder="numpy")
+    try:
+        # volumes only fuse within one (node, collection) group, so
+        # spread uploads across a single collection's volume set until
+        # two distinct volumes hold data
+        blobs = []  # (fid, data)
+        for _ in range(12):
+            d = os.urandom(1024)
+            blobs.append((c.upload(d, collection="fuse"), d))
+        vids = sorted({parse_fid(fid).volume_id for fid, _ in blobs})
+        assert len(vids) >= 2, f"need 2 volumes, uploads all hit {vids}"
+        va, vb = vids[:2]
+        out = Shell(c.master.url).run_command(
+            f"ec.encode -volumeId={va},{vb} -encoder numpy")
+        assert f"volume {va}: ec.encode done" in out
+        assert f"volume {vb}: ec.encode done" in out
+        assert calls == [[va, vb]], \
+            f"expected one fused batch call, got {calls}"
+        for fid, d in blobs:
+            if parse_fid(fid).volume_id in (va, vb):
+                with c.fetch(fid) as r:
+                    assert r.read() == d
+    finally:
+        c.stop()
+
+
 def test_admin_ui_pages(cluster):
     """Master and volume servers serve plain HTML status pages
     (reference server/*_ui)."""

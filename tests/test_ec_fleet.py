@@ -1,0 +1,304 @@
+"""Cross-volume fleet EC scheduler tests (ec/fleet.py).
+
+The fleet contract is byte-identity: fusing many volumes' chunks into
+shared RS dispatches, feeding them from a reader pool, and retiring
+writes through per-volume writer lanes must produce exactly the shard
+files the serial per-volume encoder writes. Small geometry (the
+test_ec.py pattern) keeps volumes a few KB while still exercising
+multi-row packing, tail padding, the oversized-volume fallback, and
+pipeline depth > 1.
+"""
+
+import filecmp
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu import ec
+from seaweedfs_tpu.ec import fleet, store_ec
+from seaweedfs_tpu.ec.encoder import shard_file_name
+from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS, TOTAL_SHARDS
+
+LARGE = 2048
+SMALL = 256
+ROW = DATA_SHARDS * SMALL  # 2560 bytes per small row
+
+# volume sizes chosen to hit: empty, sub-row, exact row, multi-row with
+# ragged tail, and (30KB > 10*LARGE) the per-volume large-row fallback
+SIZES = [0, 1, 700, ROW, 3 * ROW + 123, 30 << 10]
+
+
+def _make_volumes(root, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    bases = []
+    for i, sz in enumerate(sizes):
+        base = os.path.join(root, f"{i}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, sz, dtype=np.uint8).tobytes())
+        bases.append(base)
+    return bases
+
+
+def _serial_twin(bases, tag="serial"):
+    """Hard-link each .dat under a sibling name for the serial run."""
+    twins = []
+    for base in bases:
+        twin = f"{base}.{tag}"
+        os.link(base + ".dat", twin + ".dat")
+        twins.append(twin)
+    return twins
+
+
+def _assert_shards_equal(got_bases, want_bases):
+    for g, w in zip(got_bases, want_bases):
+        for sid in range(TOTAL_SHARDS):
+            gp, wp = shard_file_name(g, sid), shard_file_name(w, sid)
+            assert os.path.exists(gp), f"missing {gp}"
+            assert filecmp.cmp(gp, wp, shallow=False), \
+                f"shard {sid} of {os.path.basename(g)} differs"
+
+
+def test_fleet_encode_byte_identical_to_serial(tmp_path):
+    bases = _make_volumes(str(tmp_path), SIZES)
+    twins = _serial_twin(bases)
+    for t in twins:
+        ec.write_ec_files(t, backend="numpy", large_block=LARGE,
+                          small_block=SMALL, chunk=512)
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512)
+    _assert_shards_equal(bases, twins)
+
+
+def test_fleet_encode_single_volume_degenerates(tmp_path):
+    """One volume through the fleet == the serial path (the scheduler
+    must not require a crowd)."""
+    bases = _make_volumes(str(tmp_path), [3 * ROW + 5])
+    twins = _serial_twin(bases)
+    ec.write_ec_files(twins[0], backend="numpy", large_block=LARGE,
+                      small_block=SMALL, chunk=512)
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512)
+    _assert_shards_equal(bases, twins)
+
+
+def test_fleet_encode_parity_rows_verify(tmp_path):
+    """Pipeline-ordering regression guard (fleet side): with depth >= 2
+    and several dispatches in flight, every row's parity must verify
+    against that SAME row's data — an out-of-order parity retire
+    corrupts shards silently and only row-wise verify catches it."""
+    sizes = [5 * ROW + 7, 2 * ROW, 7 * ROW + 1111]
+    bases = _make_volumes(str(tmp_path), sizes, seed=5)
+    # chunk=512 < one row, so every row is its own dispatch: many
+    # in-flight handles per volume
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512, depth=3)
+    rs = ReedSolomon(backend="numpy")
+    for base in bases:
+        shard_bytes = [open(shard_file_name(base, i), "rb").read()
+                       for i in range(TOTAL_SHARDS)]
+        n_rows = len(shard_bytes[0]) // SMALL
+        assert n_rows > 1
+        for r in range(n_rows):
+            row = np.stack([np.frombuffer(
+                s[r * SMALL:(r + 1) * SMALL], dtype=np.uint8)
+                for s in shard_bytes])
+            assert rs.verify(row), f"row {r} of {base} fails verify"
+
+
+def test_serial_pipeline_ordering_depth2(tmp_path):
+    """Same guard for the per-volume pipeline (encoder._EncodePipeline,
+    default depth 2): chunk-per-row dispatch, row-wise verify."""
+    bases = _make_volumes(str(tmp_path), [6 * ROW + 99], seed=6)
+    ec.write_ec_files(bases[0], backend="numpy", large_block=LARGE,
+                      small_block=SMALL, chunk=512)
+    rs = ReedSolomon(backend="numpy")
+    shard_bytes = [open(shard_file_name(bases[0], i), "rb").read()
+                   for i in range(TOTAL_SHARDS)]
+    n_rows = len(shard_bytes[0]) // SMALL
+    assert n_rows >= 6  # enough dispatches to keep depth-2 busy
+    for r in range(n_rows):
+        row = np.stack([np.frombuffer(
+            s[r * SMALL:(r + 1) * SMALL], dtype=np.uint8)
+            for s in shard_bytes])
+        assert rs.verify(row), f"row {r} fails verify"
+
+
+def test_fleet_rebuild_byte_identical(tmp_path):
+    """Different volumes missing different shard sets: volumes sharing
+    a (present, missing) signature fuse into one dispatch group, the
+    rest split — all must come back byte-identical."""
+    sizes = [2 * ROW + 17, 2 * ROW + 17, ROW, 4 * ROW]
+    bases = _make_volumes(str(tmp_path), sizes, seed=2)
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512)
+    originals = {(b, sid): open(shard_file_name(b, sid), "rb").read()
+                 for b in bases for sid in range(TOTAL_SHARDS)}
+    drops = ([0, 13], [0, 13], [3], [1, 2, 11, 12])  # two share a group
+    for base, drop in zip(bases, drops):
+        for sid in drop:
+            os.remove(shard_file_name(base, sid))
+    rebuilt = fleet.fleet_rebuild_ec_files(bases, backend="numpy",
+                                           chunk=512)
+    for base, drop in zip(bases, drops):
+        assert rebuilt[base] == list(drop)
+        for sid in range(TOTAL_SHARDS):
+            with open(shard_file_name(base, sid), "rb") as f:
+                assert f.read() == originals[(base, sid)], \
+                    f"shard {sid} of {base}"
+
+
+def test_rebuild_wanted_partial(tmp_path):
+    """Satellite: rebuild_ec_files(wanted=...) regenerates ONLY the
+    wanted subset — the decode-to-volume path depends on not paying for
+    parity it will never read. Covers the serial and fleet rebuilds."""
+    bases = _make_volumes(str(tmp_path), [3 * ROW + 200, 3 * ROW + 200],
+                          seed=3)
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512)
+    originals = {(b, sid): open(shard_file_name(b, sid), "rb").read()
+                 for b in bases for sid in range(TOTAL_SHARDS)}
+    for base in bases:
+        for sid in (0, 7, 11, 13):
+            os.remove(shard_file_name(base, sid))
+    # serial: only data shards wanted -> parity stays missing
+    got = ec.rebuild_ec_files(bases[0], backend="numpy", chunk=512,
+                              wanted=list(range(DATA_SHARDS)))
+    assert sorted(got) == [0, 7]
+    for sid in (0, 7):
+        with open(shard_file_name(bases[0], sid), "rb") as f:
+            assert f.read() == originals[(bases[0], sid)]
+    for sid in (11, 13):
+        assert not os.path.exists(shard_file_name(bases[0], sid))
+    # fleet: same wanted contract
+    rebuilt = fleet.fleet_rebuild_ec_files(
+        [bases[1]], backend="numpy", chunk=512,
+        wanted=list(range(DATA_SHARDS)))
+    assert rebuilt[bases[1]] == [0, 7]
+    for sid in (0, 7):
+        with open(shard_file_name(bases[1], sid), "rb") as f:
+            assert f.read() == originals[(bases[1], sid)]
+    for sid in (11, 13):
+        assert not os.path.exists(shard_file_name(bases[1], sid))
+
+
+def test_fleet_rebuild_too_few_shards_raises(tmp_path):
+    bases = _make_volumes(str(tmp_path), [2 * ROW], seed=4)
+    fleet.fleet_write_ec_files(bases, backend="numpy", large_block=LARGE,
+                               small_block=SMALL, chunk=512)
+    for sid in range(5):
+        os.remove(shard_file_name(bases[0], sid))
+    with pytest.raises(ValueError):
+        fleet.fleet_rebuild_ec_files(bases, backend="numpy", chunk=512)
+
+
+def test_round_robin_by_size_balances(tmp_path):
+    sizes = [10 * ROW, ROW, 2 * ROW, 7 * ROW, 7 * ROW, 0, 3 * ROW]
+    bases = _make_volumes(str(tmp_path), sizes, seed=7)
+    from seaweedfs_tpu.parallel import round_robin_by_size
+    buckets = round_robin_by_size(bases, 3)
+    assert sorted(b for g in buckets for b in g) == sorted(bases)
+    loads = [sum(os.path.getsize(b + ".dat") for b in g) for g in buckets]
+    # LPT deal: no shard's byte-load exceeds another's by more than the
+    # largest volume
+    assert max(loads) - min(loads) <= max(sizes)
+    # empty volumes still get dealt somewhere
+    assert sum(len(g) for g in buckets) == len(bases)
+
+
+def test_fleet_sharded_over_host_shards(tmp_path):
+    """fleet_write_ec_files_sharded on a host backend: volumes dealt to
+    parallel per-shard schedulers, output byte-identical to serial."""
+    sizes = [3 * ROW + 1, ROW, 5 * ROW, 2 * ROW + 77]
+    bases = _make_volumes(str(tmp_path), sizes, seed=8)
+    twins = _serial_twin(bases)
+    for t in twins:
+        ec.write_ec_files(t, backend="numpy", large_block=LARGE,
+                          small_block=SMALL, chunk=512)
+    from seaweedfs_tpu.parallel import fleet_write_ec_files_sharded
+    fleet_write_ec_files_sharded(bases, devices=[None, None],
+                                 backend="numpy", large_block=LARGE,
+                                 small_block=SMALL, chunk=512)
+    _assert_shards_equal(bases, twins)
+
+
+def test_generate_ec_shards_batch_matches_serial(tmp_path):
+    """store_ec.generate_ec_shards_batch: many volumes in one fused
+    pass == generate_ec_shards per volume, including the .ecx index."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([str(tmp_path)])
+    rng = np.random.default_rng(9)
+    for vid in (1, 2, 3):
+        store.add_volume(vid)
+        v = store.find_volume(vid)
+        for i in range(1, 6):
+            v.write_needle(Needle(
+                id=i, cookie=0x20 + i,
+                data=rng.integers(0, 256, int(rng.integers(100, 4000)),
+                                  dtype=np.uint8).tobytes()))
+    # expected output: the serial per-volume generate, run on hard-
+    # linked copies of the frozen volume files
+    expected = {}
+    for vid in (1, 2, 3):
+        v = store.find_volume(vid)
+        v.sync()
+        base = v.file_name()
+        twin = os.path.join(str(tmp_path), f"twin{vid}")
+        os.link(base + ".dat", twin + ".dat")
+        os.link(base + ".idx", twin + ".idx")
+        ec.write_ec_files(twin, backend="numpy")
+        ec.write_sorted_file_from_idx(twin)
+        expected[vid] = twin
+    bases = store_ec.generate_ec_shards_batch(store, [1, 2, 3],
+                                              backend="numpy")
+    for vid, base in bases.items():
+        twin = expected[vid]
+        for sid in range(TOTAL_SHARDS):
+            assert filecmp.cmp(shard_file_name(base, sid),
+                               shard_file_name(twin, sid),
+                               shallow=False), f"vid {vid} shard {sid}"
+        assert filecmp.cmp(base + ".ecx", twin + ".ecx", shallow=False)
+        assert store.find_volume(vid).read_only  # frozen before encode
+    store.close()
+
+
+def test_generate_ec_shards_batch_unknown_vid(tmp_path):
+    from seaweedfs_tpu.storage.needle import NeedleError
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([str(tmp_path)])
+    store.add_volume(1)
+    with pytest.raises(NeedleError):
+        store_ec.generate_ec_shards_batch(store, [1, 99], backend="numpy")
+    # the whole list is validated BEFORE any volume is frozen: a bad
+    # vid must not strand volume 1 read-only with no EC shards
+    assert not store.find_volume(1).read_only
+    store.close()
+
+
+def test_parse_vid_list():
+    from seaweedfs_tpu.shell.command_ec import parse_vid_list
+    assert parse_vid_list("7") == [7]
+    assert parse_vid_list("3,4,5") == [3, 4, 5]
+    assert parse_vid_list("") == []
+    assert parse_vid_list("0") == []  # 0 == unset, like the old flag
+    with pytest.raises(ValueError):
+        parse_vid_list("3,x")
+
+
+def test_write_dat_file_backend_chunk_default(tmp_path):
+    """Satellite: write_dat_file follows the backend's chunk default
+    (no hardcoded DEFAULT_CHUNK) and still round-trips the .dat."""
+    bases = _make_volumes(str(tmp_path), [3 * ROW + 250], seed=10)
+    base = bases[0]
+    with open(base + ".dat", "rb") as f:
+        original = f.read()
+    ec.write_ec_files(base, backend="numpy", large_block=LARGE,
+                      small_block=SMALL, chunk=512)
+    os.rename(base + ".dat", base + ".dat.orig")
+    ec.write_dat_file(base, len(original), backend="numpy",
+                      large_block=LARGE, small_block=SMALL)
+    with open(base + ".dat", "rb") as f:
+        assert f.read() == original

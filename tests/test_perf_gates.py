@@ -91,6 +91,58 @@ def test_ec_kernel_floor():
             f"device EC kernel regressed: {dev_gbps:.1f} GB/s"
 
 
+def test_fleet_batched_encode_floor(tmp_path):
+    """Cross-volume fleet encode vs serial per-volume encode (8×4MB,
+    native backend, best-of-3 each to shave VM-scheduler noise).
+
+    The regression class: the fleet scheduler losing its overlap —
+    reader pool gone synchronous, writer lanes collapsed to one
+    serialized thread, encode pool bypassed. The achievable speedup is
+    core-bound: on ≥8 cores the reader/encoder/writer pools genuinely
+    run beside each other (target ≥1.5×); on the 2-core CI VM the
+    native kernel is memory-bandwidth-bound and the measured band is
+    only 0.9-1.3× (serial itself swings ±2× under load), so the floors
+    step down with cpu_count — loose on small VMs, real on big iron —
+    per the VM-load tolerance precedent on the kernel floor below.
+    """
+    from seaweedfs_tpu.ec import encoder as enc
+    from seaweedfs_tpu.ec import fleet
+    from seaweedfs_tpu.native import rs_native
+
+    backend = "native" if rs_native.available() else "numpy"
+    rng = np.random.default_rng(11)
+    block = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    vol = 4 << 20
+    serial_bases, fleet_bases = [], []
+    for v in range(8):
+        base = str(tmp_path / f"f{v}")
+        with open(base + ".dat", "wb") as f:
+            for _ in range(vol // len(block)):
+                f.write(block)
+        fleet_bases.append(base)
+        twin = str(tmp_path / f"s{v}")
+        os.link(base + ".dat", twin + ".dat")
+        serial_bases.append(twin)
+
+    serial_s, fused_s = [], []
+    for _ in range(3):  # alternate so load spikes hit both paths
+        t0 = time.perf_counter()
+        for base in serial_bases:
+            enc.write_ec_files(base, backend=backend)
+        serial_s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fleet.fleet_write_ec_files(fleet_bases, backend=backend)
+        fused_s.append(time.perf_counter() - t0)
+    speedup = min(serial_s) / min(fused_s)
+
+    ncpu = os.cpu_count() or 1
+    floor = 1.5 if ncpu >= 8 else (1.1 if ncpu >= 4 else 0.6)
+    assert speedup >= floor, \
+        f"fleet batched encode regressed: {speedup:.2f}x fused-vs-serial " \
+        f"(floor {floor}x at {ncpu} cpus; serial={min(serial_s):.3f}s " \
+        f"fused={min(fused_s):.3f}s)"
+
+
 def test_storage_engine_microbench(tmp_path):
     """Raw storage-engine floors: the engine measured 36 us/write and
     17 us/read in round 4; 500/250 us floors catch an accidental
