@@ -222,7 +222,77 @@ def fleet_batch_sweep(batches=(1, 8, 64)) -> dict:
             "volume_mb": vol_mb, "backend": backend, "sweep": sweep}
 
 
+def fleet_trace_bench(out_path: str = "bench_trace.json") -> dict:
+    """--trace mode: ONE fleet encode with span tracing enabled.
+
+    Writes the Chrome trace-event JSON (chrome://tracing / Perfetto
+    loadable) to `out_path` and returns a BENCH line whose `stages`
+    field is the per-phase span rollup — stage-level attribution for
+    future perf PRs — and whose `value` is the fraction of wall time
+    covered by at least one read/dispatch/rs/retire/write span (the
+    >=90% acceptance gate: below that, the tracer is missing where
+    time goes and its numbers can't be trusted for attribution).
+    """
+    import tempfile
+
+    from seaweedfs_tpu.ec import fleet
+    from seaweedfs_tpu.stats import trace
+
+    backend = os.environ.get("BENCH_FLEET_BACKEND") or _cpu_backend()
+    n = int(os.environ.get("BENCH_TRACE_VOLUMES", "8"))
+    vol_mb = int(os.environ.get("BENCH_TRACE_VOL_MB", "16"))
+    vol_bytes = vol_mb << 20
+    block = np.random.default_rng(7).integers(
+        0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    with tempfile.TemporaryDirectory() as d:
+        bases = []
+        for v in range(n):
+            base = os.path.join(d, f"t{v}")
+            with open(base + ".dat", "wb") as f:
+                written = 0
+                while written < vol_bytes:
+                    written += f.write(block[: vol_bytes - written])
+            bases.append(base)
+        # warm once untraced (page cache, native lib load, thread pools)
+        fleet.fleet_write_ec_files(bases[:1], backend=backend)
+        trace.enable()
+        trace.clear()
+        t0 = time.perf_counter()
+        fleet.fleet_write_ec_files(bases, backend=backend)
+        wall = time.perf_counter() - t0
+        spans = trace.spans()
+        trace.disable()
+    stage_prefixes = ("fleet.read", "fleet.dispatch", "fleet.rs",
+                      "fleet.retire", "fleet.write")
+    covered = trace.busy_union_s(spans, t0, t0 + wall,
+                                 prefixes=stage_prefixes)
+    with open(out_path, "w") as f:
+        json.dump(trace.chrome_trace(), f)
+    trace.clear()
+    return {
+        "metric": "ec_fleet_trace_coverage",
+        "value": round(covered / wall, 4),
+        "unit": "fraction",
+        "coverage_ok": covered / wall >= 0.9,
+        "wall_s": round(wall, 4),
+        "volumes": n,
+        "volume_mb": vol_mb,
+        "backend": backend,
+        "n_spans": len(spans),
+        "stages": trace.rollup(spans),
+        "trace_file": out_path,
+    }
+
+
 def main() -> None:
+    if "--trace" in sys.argv:
+        # trace mode is host-pipeline only (no TPU needed): stage
+        # attribution of the fleet scheduler, not the kernel headline
+        i = sys.argv.index("--trace")
+        out_path = sys.argv[i + 1] if len(sys.argv) > i + 1 and \
+            not sys.argv[i + 1].startswith("-") else "bench_trace.json"
+        print(json.dumps(fleet_trace_bench(out_path)), flush=True)
+        return
     backend = _cpu_backend()
     enc_m, reb_m = _matrices()
     cpu_enc = cpu_phase_gbps(enc_m, backend)

@@ -9,7 +9,13 @@ the question VERDICT r4 asked about the remaining write-plane gap:
 where do the server's cycles actually go per request — interpreter work
 we can shave, or kernel/socket time that is the floor?
 
-Usage: python bench_profile.py [write|read|both] [n]
+With --trace, both servers run with SEAWEED_TRACE=1 and a metrics port,
+and after the load each server's span ring is pulled from its
+/debug/trace endpoint into <role>.trace.json (Chrome trace-event JSON,
+chrome://tracing / Perfetto loadable) with a per-span-name rollup
+printed — request-level attribution to complement the cProfile view.
+
+Usage: python bench_profile.py [write|read|both] [n] [--trace]
 """
 
 from __future__ import annotations
@@ -38,12 +44,41 @@ def _free_port() -> int:
     return port
 
 
-def _spawn(*args: str) -> subprocess.Popen:
+def _spawn(*args: str, trace: bool = False) -> subprocess.Popen:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if trace:
+        env["SEAWEED_TRACE"] = "1"
     return subprocess.Popen(
         [sys.executable, "-m", "seaweedfs_tpu", *args],
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
         cwd=REPO, env=env)
+
+
+def _pull_trace(name: str, metrics_port: int) -> None:
+    """Fetch /debug/trace from a server's metrics endpoint, save the
+    Chrome JSON, print the per-span-name rollup."""
+    import json
+    url = f"http://127.0.0.1:{metrics_port}/debug/trace"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            doc = json.load(r)
+    except OSError as e:
+        print(f"[no trace from {name}: {e}]")
+        return
+    out = f"{name.replace(' ', '_')}.trace.json"
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    rollup: dict = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        r = rollup.setdefault(ev["name"], [0, 0.0])
+        r[0] += 1
+        r[1] += ev.get("dur", 0.0) / 1e6
+    print(f"\n===== {name} — spans ({out}) =====")
+    for span_name, (count, total) in sorted(
+            rollup.items(), key=lambda kv: -kv[1][1])[:20]:
+        print(f"{total:10.3f}s  {count:8d}x  {span_name}")
 
 
 def _wait_http(url: str, timeout: float = 30.0) -> None:
@@ -75,24 +110,33 @@ def _report(name: str, prof_path: str, top: int = 25) -> None:
 
 
 def main() -> None:
-    which = sys.argv[1] if len(sys.argv) > 1 else "both"
-    n = int(sys.argv[2]) if len(sys.argv) > 2 else 20_000
+    argv = [a for a in sys.argv[1:] if a != "--trace"]
+    do_trace = "--trace" in sys.argv[1:]
+    which = argv[0] if argv else "both"
+    n = int(argv[1]) if len(argv) > 1 else 20_000
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="prof-"))
     mport, vport = _free_port(), _free_port()
+    m_metrics, v_metrics = _free_port(), _free_port()
     mprof, vprof = str(tmp / "master.prof"), str(tmp / "volume.prof")
     procs = []
     try:
         procs.append(_spawn(
             "master", "-port", str(mport), "-mdir", str(tmp / "m"),
-            "-cpuprofile", mprof))
+            "-cpuprofile", mprof, "-metricsPort", str(m_metrics),
+            trace=do_trace))
         _wait_http(f"http://127.0.0.1:{mport}/cluster/status")
         procs.append(_spawn(
             "volume", "-port", str(vport), "-dir", str(tmp / "v"),
             "-mserver", f"127.0.0.1:{mport}", "-pulseSeconds", "0.3",
-            "-cpuprofile", vprof))
+            "-cpuprofile", vprof, "-metricsPort", str(v_metrics),
+            trace=do_trace))
         _wait_http(f"http://127.0.0.1:{vport}/status")
+        if do_trace:
+            # readiness via the new /healthz probes on the metrics ports
+            _wait_http(f"http://127.0.0.1:{m_metrics}/healthz")
+            _wait_http(f"http://127.0.0.1:{v_metrics}/healthz")
         time.sleep(1.0)  # let the first heartbeat register the volumes
 
         from seaweedfs_tpu.command.benchmark import \
@@ -108,6 +152,10 @@ def main() -> None:
                       f"({st.completed} ok, {st.failed} failed, "
                       f"{secs:.1f}s)")
     finally:
+        if do_trace:
+            # pull span rings BEFORE SIGTERM tears the servers down
+            _pull_trace("volume server", v_metrics)
+            _pull_trace("master server", m_metrics)
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)

@@ -29,14 +29,15 @@ def _setup_tls(role: str) -> None:
     setup_client_tls(role)
 
 
-def _maybe_start_metrics(opts) -> None:
+def _maybe_start_metrics(opts, role: str = "") -> None:
     """Expose Prometheus text metrics on -metricsPort (reference
     stats/metrics.go:172 StartMetricsServer; one shared registry per
-    process)."""
+    process), plus /healthz (role + uptime) and /debug/trace (Chrome
+    trace JSON of the span ring when tracing is enabled)."""
     port = getattr(opts, "metrics_port", 0)
     if port:
         from seaweedfs_tpu.stats.metrics import start_metrics_server
-        srv = start_metrics_server(port)
+        srv = start_metrics_server(port, role=role)
         grace.on_interrupt(srv.shutdown)
         log.info("metrics exposed on :%d/metrics", port)
 
@@ -119,7 +120,7 @@ def run_master(args) -> int:
     _setup_tls("master")
     opts = _master_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
-    _maybe_start_metrics(opts)
+    _maybe_start_metrics(opts, role="master")
     m = _build_master(opts)
     m.start()
     return _serve_forever([m])
@@ -193,7 +194,7 @@ def run_volume(args) -> int:
     _setup_tls("volume")
     opts = _volume_parser().parse_args(args)
     grace.setup_profiling(opts.cpuprofile)
-    _maybe_start_metrics(opts)
+    _maybe_start_metrics(opts, role="volume")
     vs = _build_volume(opts)
     vs.start()
     return _serve_forever([vs])
@@ -259,7 +260,7 @@ def _build_filer(opts):
 def run_filer(args) -> int:
     _setup_tls("filer")
     opts = _filer_parser().parse_args(args)
-    _maybe_start_metrics(opts)
+    _maybe_start_metrics(opts, role="filer")
     fs = _build_filer(opts)
     fs.start()
     return _serve_forever([fs])
@@ -300,7 +301,7 @@ def _s3_parser() -> argparse.ArgumentParser:
 @command("s3", "start an S3-compatible gateway")
 def run_s3(args) -> int:
     opts = _s3_parser().parse_args(args)
-    _maybe_start_metrics(opts)
+    _maybe_start_metrics(opts, role="s3")
     from seaweedfs_tpu.s3api.server import S3ApiServer
     s3 = S3ApiServer(opts.filer, ip=opts.ip, port=opts.port,
                      iam=_load_iam(opts.config))

@@ -45,6 +45,7 @@ import functools
 import os
 import queue
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -55,6 +56,11 @@ from seaweedfs_tpu.ec import encoder as _encoder
 from seaweedfs_tpu.ec.encoder import (
     LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, default_chunk_for, shard_file_name)
 from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS, TOTAL_SHARDS
+from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats.metrics import (
+    FleetDispatchBatchHistogram, FleetDispatchedBytesCounter,
+    FleetReaderQueueGauge, FleetStageSecondsHistogram,
+    FleetWriterBacklogGauge)
 
 # Reader-pool width: enough to keep several volumes' sequential reads
 # in flight without degrading each stream to fully random IO.
@@ -81,6 +87,41 @@ FLEET_WRITERS = max(2, min(4, os.cpu_count() or 2))
 _LANE_QUEUE = 4
 
 
+# Stage-latency children resolved once at import: labels() takes a
+# lock per call, and a stage interval closes for every chunk-sized
+# unit of work.
+_STAGE_HIST = {s: FleetStageSecondsHistogram.labels(s)
+               for s in ("read", "dispatch", "rs", "retire", "write")}
+
+
+class _StageTimer:
+    """One pipeline-stage interval: always observed into the per-stage
+    latency histogram, and additionally recorded as a trace span when
+    tracing is enabled (parented across threads via a handoff token).
+    Span allocation is gated on the trace flag so the disabled path
+    costs one histogram observe per chunk-sized unit of work."""
+
+    __slots__ = ("_hist", "_span", "_t0")
+
+    def __init__(self, stage: str, parent: Optional[int] = None, **tags):
+        self._hist = _STAGE_HIST[stage]
+        self._span = trace.span("fleet." + stage, parent=parent, **tags) \
+            if trace.is_enabled() else trace.NOOP
+
+    def __enter__(self) -> "_StageTimer":
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._hist.observe(time.perf_counter() - self._t0)
+        return self._span.__exit__(*exc)
+
+    def token(self) -> Optional[int]:
+        """Handoff token of the underlying span (None when disabled)."""
+        return self._span.token()
+
+
 class TaggedPipeline:
     """Tagged completion queue: fused dispatches retire FIFO, writes
     fan out to per-volume writer lanes.
@@ -99,14 +140,17 @@ class TaggedPipeline:
 
     def __init__(self, depth: int = FLEET_DEPTH,
                  writers: int = FLEET_WRITERS):
-        self._lanes: List["queue.Queue[Optional[Callable]]"] = [
+        self._lanes: List["queue.Queue[Optional[Tuple]]"] = [
             queue.Queue(maxsize=_LANE_QUEUE)
             for _ in range(max(1, writers))]
         self._retireq: "queue.Queue[Optional[Tuple]]" = \
             queue.Queue(maxsize=max(1, depth))
         self._exc: Optional[BaseException] = None
+        # per-lane backlog gauges resolved once: labels() locks per call
+        self._lane_gauges = [FleetWriterBacklogGauge.labels(str(i))
+                             for i in range(len(self._lanes))]
         self._writers = [
-            threading.Thread(target=self._drain_lane, args=(q,),
+            threading.Thread(target=self._drain_lane, args=(q, i),
                              name=f"fleet-write-{i}", daemon=True)
             for i, q in enumerate(self._lanes)]
         self._retirer = threading.Thread(
@@ -115,13 +159,20 @@ class TaggedPipeline:
             t.start()
         self._retirer.start()
 
-    def _lane(self, tag: int) -> "queue.Queue[Optional[Callable]]":
-        return self._lanes[tag % len(self._lanes)]
+    def _put_lane(self, tag: int, fn: Callable[[], None],
+                  token: Optional[int]) -> None:
+        lane = tag % len(self._lanes)
+        # inc/dec deltas, not set(qsize): several schedulers run
+        # concurrently (mesh sharding, parallel generate RPCs) and
+        # share these children, so the gauge must SUM their backlogs
+        # rather than last-write-wins one scheduler's view
+        self._lane_gauges[lane].inc()
+        self._lanes[lane].put((fn, token))
 
     def write(self, tag: int, fn: Callable[[], None]) -> None:
         """Enqueue one ordered write on `tag`'s lane (no handle)."""
         self._raise_pending()
-        self._lane(tag).put(fn)
+        self._put_lane(tag, fn, trace.handoff())
 
     def submit(self, handle,
                tagged: Sequence[Tuple[int, Callable]]) -> None:
@@ -129,7 +180,7 @@ class TaggedPipeline:
         output goes to `tagged[i] = (tag, fn)` as `fn(outs[i])` on
         tag's lane."""
         self._raise_pending()
-        self._retireq.put((handle, list(tagged)))
+        self._retireq.put((handle, list(tagged), trace.handoff()))
 
     def _retire_loop(self) -> None:
         while True:
@@ -138,25 +189,36 @@ class TaggedPipeline:
                 return
             if self._exc is not None:
                 continue  # failed: keep draining, write nothing more
-            handle, tagged = item
+            handle, tagged, token = item
             try:
-                outs = handle.result()
+                # the retire stage is where async dispatches actually
+                # resolve — for the jax backend this wait IS the device
+                # time (block_until_ready), for host backends the encode
+                # pool's compute; the lane puts after it are writer-side
+                # backpressure, also this stage's problem
+                with _StageTimer("retire", parent=token,
+                                 spans=len(tagged)) as st:
+                    outs = handle.result()
+                    for (tag, fn), out in zip(tagged, outs):
+                        self._put_lane(tag, functools.partial(fn, out),
+                                       st.token())
             except BaseException as e:  # surfaced on submit/drain
                 if self._exc is None:
                     self._exc = e
-                continue
-            for (tag, fn), out in zip(tagged, outs):
-                self._lane(tag).put(functools.partial(fn, out))
 
-    def _drain_lane(self, q: "queue.Queue[Optional[Callable]]") -> None:
+    def _drain_lane(self, q: "queue.Queue[Optional[Tuple]]",
+                    lane: int) -> None:
         while True:
-            fn = q.get()
-            if fn is None:
+            item = q.get()
+            if item is None:
                 return
+            self._lane_gauges[lane].dec()
             if self._exc is not None:
                 continue
+            fn, token = item
             try:
-                fn()
+                with _StageTimer("write", parent=token, lane=lane):
+                    fn()
             except BaseException as e:
                 if self._exc is None:
                     self._exc = e
@@ -191,6 +253,14 @@ class _Gathered:
         return [h.result() for h in self._handles]
 
 
+def _rs_staged(fn, arr: np.ndarray, parent: Optional[int]) -> np.ndarray:
+    """One host-backend RS compute task, attributed to the 'rs' stage
+    (the jax path's device time shows up in 'retire' instead, where
+    handle.result() blocks)."""
+    with _StageTimer("rs", parent=parent):
+        return fn(arr)
+
+
 class _Dispatcher:
     """Uniform async-handle dispatch over any RS backend.
 
@@ -220,7 +290,9 @@ class _Dispatcher:
             rows = [a.shape[0] for a in arrays]
             handle = self._rs.encode_async(data, device=self._device)
             return _SplitHandle(handle, rows)
-        return _Gathered([self._pool.submit(self._rs.encode, a)
+        token = trace.handoff()
+        return _Gathered([self._pool.submit(_rs_staged, self._rs.encode,
+                                            a, token)
                           for a in arrays])
 
     def reconstruct(self, present, missing, arrays: List[np.ndarray]):
@@ -229,8 +301,11 @@ class _Dispatcher:
             handle = self._rs.reconstruct_some_async(
                 present, missing, src, device=self._device)
             return _UnstackHandle(handle)
+        token = trace.handoff()
         return _Gathered([self._pool.submit(
-            self._rs.reconstruct_some, present, missing, a)
+            _rs_staged,
+            functools.partial(self._rs.reconstruct_some, present, missing),
+            a, token)
             for a in arrays])
 
     def close(self) -> None:
@@ -313,6 +388,14 @@ def _read_span(base: str, row0: int, rows: int,
     return buf.reshape(rows, DATA_SHARDS, small_block)
 
 
+def _read_span_staged(base: str, row0: int, rows: int, row_bytes: int,
+                      small_block: int, parent: Optional[int]) -> np.ndarray:
+    """_read_span on a reader-pool thread, attributed to the 'read'
+    stage and parented to the scheduler's root span."""
+    with _StageTimer("read", parent=parent, vol=os.path.basename(base)):
+        return _read_span(base, row0, rows, row_bytes, small_block)
+
+
 def _write_data_shards(base: str, arr: np.ndarray) -> None:
     for i in range(DATA_SHARDS):
         _append_rows(base, i, [arr[r, i] for r in range(arr.shape[0])])
@@ -356,11 +439,15 @@ def fleet_write_ec_files(base_names: Sequence[str], backend: str = "auto",
         return
     row_bytes = DATA_SHARDS * small_block
     vols = []
-    for tag, base in enumerate(fleet):
-        size = os.path.getsize(base + ".dat")
-        vols.append(_VolState(base, size, -(-size // row_bytes), tag))
-        for i in range(TOTAL_SHARDS):  # create/truncate all 14 outputs
-            open(shard_file_name(base, i), "wb").close()
+    # creating/truncating 14 output files per volume is real write-side
+    # IO (measured ~10% of a small fleet's wall time), so it carries
+    # the write stage's span/metric attribution
+    with _StageTimer("write", setup=len(fleet)):
+        for tag, base in enumerate(fleet):
+            size = os.path.getsize(base + ".dat")
+            vols.append(_VolState(base, size, -(-size // row_bytes), tag))
+            for i in range(TOTAL_SHARDS):  # create/truncate all 14 outputs
+                open(shard_file_name(base, i), "wb").close()
     alive = [v for v in vols if v.n_rows > 0]
     if not alive:
         return  # all empty: 14 empty shard files each, same as serial
@@ -379,18 +466,29 @@ def fleet_write_ec_files(base_names: Sequence[str], backend: str = "auto",
     pipe = TaggedPipeline(depth=depth)
     gen = _round_robin_spans(alive, span_rows)
     inflight: deque = deque()
+    root = trace.span("fleet.encode", volumes=len(alive), backend=backend)
+    root.__enter__()
+    token = root.token()
 
     def fill() -> None:
         while len(inflight) < prefetch:
             nxt = next(gen, None)
             if nxt is None:
-                return
+                break
             v, row0, rows = nxt
             inflight.append((v, rows, pool.submit(
-                _read_span, v.base, row0, rows, row_bytes, small_block)))
+                _read_span_staged, v.base, row0, rows, row_bytes,
+                small_block, token)))
+            # inc/dec deltas so concurrent schedulers SUM on the
+            # shared gauge instead of overwriting each other's depth
+            FleetReaderQueueGauge.inc()
 
     def flush(pack: List[Tuple[_VolState, int, np.ndarray]]) -> None:
-        handle = dispatcher.encode([a for _, _, a in pack])
+        with _StageTimer("dispatch", batch=len(pack)):
+            handle = dispatcher.encode([a for _, _, a in pack])
+        FleetDispatchBatchHistogram.observe(len(pack))
+        FleetDispatchedBytesCounter.inc(
+            float(sum(a.nbytes for _, _, a in pack)))
         # data shards need no parity: straight to each volume's lane
         # (enqueued here, in pack order, so per-volume FIFO holds)
         for v, _, arr in pack:
@@ -406,6 +504,7 @@ def fleet_write_ec_files(base_names: Sequence[str], backend: str = "auto",
         acc = 0
         while inflight:
             v, rows, fut = inflight.popleft()
+            FleetReaderQueueGauge.dec()
             pack.append((v, rows, fut.result()))
             acc += rows
             fill()
@@ -413,11 +512,13 @@ def fleet_write_ec_files(base_names: Sequence[str], backend: str = "auto",
                 flush(pack)
                 pack, acc = [], 0
     finally:
+        FleetReaderQueueGauge.dec(len(inflight))  # error path leftovers
         pool.shutdown(wait=True)
         try:
             pipe.drain()  # may re-raise the latched pipeline error
         finally:
             dispatcher.close()
+            root.__exit__(None, None, None)
 
 
 # --- fleet rebuild -----------------------------------------------------------
@@ -474,17 +575,19 @@ def _write_rebuilt_span(base: str, missing: List[int], valid: int,
 
 
 def _read_present_span(base: str, present: List[int], shard_size: int,
-                       offset: int, span: int) -> np.ndarray:
+                       offset: int, span: int,
+                       parent: Optional[int] = None) -> np.ndarray:
     """[10, span] slice at `offset` of the first 10 present shards,
     zero-padded past shard end."""
-    src = np.zeros((DATA_SHARDS, span), dtype=np.uint8)
-    want = min(span, max(shard_size - offset, 0))
-    if want > 0:
-        for row, sid in enumerate(present[:DATA_SHARDS]):
-            with open(shard_file_name(base, sid), "rb") as f:
-                f.seek(offset)
-                f.readinto(memoryview(src[row])[:want])
-    return src
+    with _StageTimer("read", parent=parent, vol=os.path.basename(base)):
+        src = np.zeros((DATA_SHARDS, span), dtype=np.uint8)
+        want = min(span, max(shard_size - offset, 0))
+        if want > 0:
+            for row, sid in enumerate(present[:DATA_SHARDS]):
+                with open(shard_file_name(base, sid), "rb") as f:
+                    f.seek(offset)
+                    f.readinto(memoryview(src[row])[:want])
+        return src
 
 
 def _fleet_rebuild_group(present: List[int], missing: List[int],
@@ -513,20 +616,29 @@ def _fleet_rebuild_group(present: List[int], missing: List[int],
     inflight: deque = deque()
     per_batch = len(members)
     prefetch = max(readers, 2 * per_batch)
+    root = trace.span("fleet.rebuild", volumes=len(members),
+                      backend=backend)
+    root.__enter__()
+    token = root.token()
 
     def fill() -> None:
         while len(inflight) < prefetch:
             nxt = next(gen, None)
             if nxt is None:
-                return
+                break
             v, offset = nxt
             inflight.append((v, offset, pool.submit(
                 _read_present_span, v.base, present, v.dat_size,
-                offset, span)))
+                offset, span, token)))
+            FleetReaderQueueGauge.inc()  # delta: concurrent-safe sum
 
     def flush(pack) -> None:
-        handle = dispatcher.reconstruct(present, missing,
-                                        [a for _, _, a in pack])
+        with _StageTimer("dispatch", batch=len(pack)):
+            handle = dispatcher.reconstruct(present, missing,
+                                            [a for _, _, a in pack])
+        FleetDispatchBatchHistogram.observe(len(pack))
+        FleetDispatchedBytesCounter.inc(
+            float(sum(a.nbytes for _, _, a in pack)))
         pipe.submit(handle, [
             (v.tag, functools.partial(_write_rebuilt_span, v.base,
                                       missing,
@@ -538,14 +650,17 @@ def _fleet_rebuild_group(present: List[int], missing: List[int],
         pack = []
         while inflight:
             item = inflight.popleft()
+            FleetReaderQueueGauge.dec()
             pack.append((item[0], item[1], item[2].result()))
             fill()
             if len(pack) >= per_batch or not inflight:
                 flush(pack)
                 pack = []
     finally:
+        FleetReaderQueueGauge.dec(len(inflight))  # error path leftovers
         pool.shutdown(wait=True)
         try:
             pipe.drain()  # may re-raise the latched pipeline error
         finally:
             dispatcher.close()
+            root.__exit__(None, None, None)

@@ -17,6 +17,7 @@ from seaweedfs_tpu.ec import encoder, fleet
 from seaweedfs_tpu.ec.ec_volume import EcVolume, EcShardNotFound
 from seaweedfs_tpu.ec.shard_bits import TOTAL_SHARDS
 from seaweedfs_tpu.ops.rs_code import ReedSolomon
+from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.storage.needle import Needle, NeedleError
 from seaweedfs_tpu.storage.store import Store
 
@@ -66,8 +67,9 @@ def generate_ec_shards(store: Store, vid: int, backend: str = "auto") -> str:
     v.read_only = True
     v.sync()
     base = v.file_name()
-    encoder.write_ec_files(base, backend=backend)
-    encoder.write_sorted_file_from_idx(base)
+    with trace.span("store_ec.generate", vid=vid):
+        encoder.write_ec_files(base, backend=backend)
+        encoder.write_sorted_file_from_idx(base)
     return base
 
 
@@ -91,9 +93,11 @@ def generate_ec_shards_batch(store: Store, vids: Sequence[int],
         v.read_only = True
         v.sync()
         bases[vid] = v.file_name()
-    fleet.fleet_write_ec_files(list(bases.values()), backend=backend)
-    for base in bases.values():
-        encoder.write_sorted_file_from_idx(base)
+    with trace.span("store_ec.generate_batch", volumes=len(bases)):
+        fleet.fleet_write_ec_files(list(bases.values()), backend=backend)
+        with trace.span("store_ec.write_ecx"):
+            for base in bases.values():
+                encoder.write_sorted_file_from_idx(base)
     return bases
 
 
@@ -104,7 +108,8 @@ def rebuild_ec_shards(store: Store, vid: int, collection: Optional[str] = None,
     base = _find_ec_base(store, vid, collection)
     if base is None:
         raise EcShardNotFound(f"no local ec files for volume {vid}")
-    return encoder.rebuild_ec_files(base, backend=backend)
+    with trace.span("store_ec.rebuild", vid=vid):
+        return encoder.rebuild_ec_files(base, backend=backend)
 
 
 def mount_ec_shards(store: Store, vid: int, collection: str,

@@ -128,12 +128,22 @@ def make_stub(pb2_module, service_name: str, target: str):
         return _stub_cache.setdefault(key, stub)
 
 
-def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcHandler:
+def generic_handler(pb2_module, service_name: str, servicer,
+                    stats_role: Optional[str] = None) -> grpc.GenericRpcHandler:
     """Route RPCs of one service to same-named methods on `servicer`.
 
     Unimplemented methods raise UNIMPLEMENTED instead of failing at
     registration, so servers can grow their surface incrementally.
+
+    Every implemented method is wrapped with the shared request
+    counter/latency instrumentation (stats.metrics.instrument_grpc_method)
+    under the `stats_role` type label — lowerCamel of the service name
+    when the caller doesn't pass one — so all roles' gRPC planes report
+    uniformly instead of each hand-rolling stats.
     """
+    from seaweedfs_tpu.stats.metrics import instrument_grpc_method
+    if stats_role is None:
+        stats_role = service_name[:1].lower() + service_name[1:]
     svc, specs = _service_specs(pb2_module, service_name)
     handlers = {}
     for spec in specs:
@@ -142,6 +152,10 @@ def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcH
             def fn(request, context, _name=spec.name):  # noqa: ARG001
                 context.abort(grpc.StatusCode.UNIMPLEMENTED,
                               f"method {_name} not implemented")
+        else:
+            fn = instrument_grpc_method(
+                fn, stats_role, spec.name,
+                server_streaming=spec.server_streaming)
         if spec.client_streaming and spec.server_streaming:
             make = grpc.stream_stream_rpc_method_handler
         elif spec.client_streaming:

@@ -817,7 +817,8 @@ def _make_handler(s3: S3ApiServer):
                 return self._reply(204)
             self._error("MethodNotAllowed", self.command, 405)
 
-    return Handler
+    from seaweedfs_tpu.stats.metrics import instrument_http_handler
+    return instrument_http_handler(Handler, "s3")
 
 
 

@@ -250,7 +250,8 @@ class FilerServer:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> None:
-        handler = rpc.generic_handler(filer_pb2, "SeaweedFiler", self)
+        handler = rpc.generic_handler(filer_pb2, "SeaweedFiler", self,
+                                      stats_role="filer")
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
         self._http_server = TrackingHTTPServer(
@@ -841,7 +842,8 @@ def _make_http_handler(fs: FilerServer):
                 return
             self._reply(204)
 
-    return Handler
+    from seaweedfs_tpu.stats.metrics import instrument_http_handler
+    return instrument_http_handler(Handler, "filer")
 
 
 def _parse_ttl_seconds(s: str) -> int:

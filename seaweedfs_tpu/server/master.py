@@ -155,8 +155,10 @@ class MasterServer:
     def start(self) -> None:
         if self.port == 0:
             raise ValueError("master port must be fixed (grpc = port+10000)")
-        handler = rpc.generic_handler(master_pb2, "Seaweed", self)
-        raft_handler = rpc.generic_handler(raft_pb2, "Raft", self.raft)
+        handler = rpc.generic_handler(master_pb2, "Seaweed", self,
+                                      stats_role="master")
+        raft_handler = rpc.generic_handler(raft_pb2, "Raft", self.raft,
+                                           stats_role="raft")
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}",
             [handler, raft_handler])
@@ -812,7 +814,8 @@ def _make_http_handler(ms: MasterServer):
 
         do_POST = do_GET
 
-    return Handler
+    from seaweedfs_tpu.stats.metrics import instrument_http_handler
+    return instrument_http_handler(Handler, "master")
 
 
 def _master_ui(ms: MasterServer) -> str:

@@ -982,8 +982,7 @@ def parse_multipart(content_type: str, body: bytes):
 
 
 def _make_http_handler(vs: VolumeServer):
-    from seaweedfs_tpu.stats.metrics import (RequestCounter,
-                                             RequestHistogram)
+    from seaweedfs_tpu.stats.metrics import instrument_http_handler
 
     class Handler(FastHandler):
         protocol_version = "HTTP/1.1"
@@ -1335,27 +1334,8 @@ def _make_http_handler(vs: VolumeServer):
                 return
             self._json({"size": size}, code=202)
 
-    # Prometheus request counter + latency per HTTP verb (reference
-    # volume_server_handlers.go stats wrappers). Wrapping the do_*
-    # dispatch — not handle_one_request — so keep-alive idle time
-    # between requests is never measured as request latency.
-    def _instrument(methname):
-        orig = getattr(Handler, methname)
-        verb = methname[3:].lower()
-        # resolve the labeled children once — labels() takes a lock per
-        # call, measurable at data-plane request rates
-        counter = RequestCounter.labels("volumeServer", verb)
-        histogram = RequestHistogram.labels("volumeServer", verb)
-
-        def wrapped(self):
-            t0 = time.perf_counter()
-            try:
-                orig(self)
-            finally:
-                counter.inc()
-                histogram.observe(time.perf_counter() - t0)
-        return wrapped
-
-    for _m in ("do_GET", "do_HEAD", "do_POST", "do_DELETE"):
-        setattr(Handler, _m, _instrument(_m))
-    return Handler
+    # Prometheus request counter + latency + trace span per HTTP verb
+    # (reference volume_server_handlers.go stats wrappers), via the
+    # shared role decorator — one instrumentation point for every
+    # server role's HTTP plane.
+    return instrument_http_handler(Handler, "volumeServer")

@@ -305,4 +305,5 @@ def _make_handler(dav: WebDavServer):
         def do_UNLOCK(self):
             self._reply(204)
 
-    return Handler
+    from seaweedfs_tpu.stats.metrics import instrument_http_handler
+    return instrument_http_handler(Handler, "webdav")

@@ -16,6 +16,7 @@ from seaweedfs_tpu.ec.shard_bits import ShardBits, DATA_SHARDS, TOTAL_SHARDS
 from seaweedfs_tpu.pb import volume_server_pb2
 from seaweedfs_tpu.shell import command, ec_common
 from seaweedfs_tpu.shell.command_env import CommandEnv, EcNode
+from seaweedfs_tpu.stats import trace
 
 
 @command("ec.encode", "erasure-code volumes (one, a list, or all full "
@@ -77,10 +78,15 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
                         env.volume_server(url).VolumeMarkReadonly(
                             volume_server_pb2.VolumeMarkReadonlyRequest(
                                 volume_id=vid))
-                env.volume_server(source).VolumeEcShardsGenerate(
-                    volume_server_pb2.VolumeEcShardsGenerateRequest(
-                        volume_id=group[0], volume_ids=group,
-                        collection=collection, encoder=encoder))
+                # the client-side view of the fused generate: with
+                # tracing on, this span brackets the whole server-side
+                # fleet encode from the shell's vantage point
+                with trace.span("shell.ec_encode.generate",
+                                source=source, volumes=len(group)):
+                    env.volume_server(source).VolumeEcShardsGenerate(
+                        volume_server_pb2.VolumeEcShardsGenerateRequest(
+                            volume_id=group[0], volume_ids=group,
+                            collection=collection, encoder=encoder))
             except Exception as e:
                 failures.append(f"volumes {group}: generate failed: {e}")
                 out.write(failures[-1] + "\n")
@@ -101,8 +107,9 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
             # frozen with unspread shards
             for vid in group:
                 try:
-                    _spread_and_retire(env, vid, collection, source,
-                                       resolved[vid], out)
+                    with trace.span("shell.ec_encode.spread", vid=vid):
+                        _spread_and_retire(env, vid, collection, source,
+                                           resolved[vid], out)
                 except Exception as e:
                     failures.append(f"volume {vid}: {e}")
                     out.write(f"volume {vid}: ec.encode failed: {e}\n")

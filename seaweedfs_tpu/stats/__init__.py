@@ -1,6 +1,8 @@
-"""Prometheus-style metrics (reference: weed/stats)."""
+"""Prometheus-style metrics + span tracing (reference: weed/stats)."""
 
+from seaweedfs_tpu.stats import trace  # noqa: F401
 from seaweedfs_tpu.stats.metrics import (  # noqa: F401
     Counter, Gauge, Histogram, Registry, REGISTRY,
+    instrument_grpc_method, instrument_http_handler,
     start_metrics_server,
 )

@@ -23,9 +23,17 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote
+    and newline must be escaped or the exposition is unparseable
+    (https://prometheus.io/docs/instrumenting/exposition_formats/)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...],
                 extra: str = "") -> str:
-    parts = [f'{n}="{v}"' for n, v in zip(names, values)]
+    parts = [f'{n}="{_escape_label_value(v)}"'
+             for n, v in zip(names, values)]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -110,6 +118,9 @@ class Gauge(Counter):
 
     def set(self, v: float) -> None:
         self.labels().set(v)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.labels().dec(amount)
 
 
 class _HistogramChild:
@@ -224,16 +235,146 @@ VolumeServerVolumeCounter = REGISTRY.gauge(
     "SeaweedFS_volumeServer_volumes", "volume count", ("collection", "type"))
 VolumeServerDiskSizeGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_total_disk_size", "disk size", ("collection", "type"))
+MetricsPushErrorCounter = REGISTRY.counter(
+    "SeaweedFS_metrics_push_errors_total",
+    "failed pushes to the metrics gateway")
+
+# Fleet-pipeline families (ec/fleet.py): the EC scheduler's stages as
+# first-class metrics, so the next perf PR sees which stage saturates
+# without attaching a tracer.
+FleetStageSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_fleet_stage_seconds",
+    "fleet scheduler per-stage latency", ("stage",))
+FleetReaderQueueGauge = REGISTRY.gauge(
+    "SeaweedFS_fleet_reader_queue_depth",
+    "spans prefetched by the reader pool, not yet packed")
+FleetDispatchBatchHistogram = REGISTRY.histogram(
+    "SeaweedFS_fleet_dispatch_batch_spans",
+    "volume spans fused into one RS dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+FleetDispatchedBytesCounter = REGISTRY.counter(
+    "SeaweedFS_fleet_dispatched_bytes_total",
+    "data bytes through fused RS dispatches")
+FleetWriterBacklogGauge = REGISTRY.gauge(
+    "SeaweedFS_fleet_writer_lane_backlog",
+    "writes queued on one writer lane", ("lane",))
+
+
+# -- shared request instrumentation -------------------------------------------
+#
+# Every server role wires RequestCounter/RequestHistogram (and, when
+# tracing is enabled, a span per request) through these two wrappers
+# instead of hand-rolling per-handler timing. Labeled children are
+# resolved once at wrap time — labels() takes a lock per call, which is
+# measurable at data-plane request rates.
+
+def instrument_http_handler(handler_cls, role: str):
+    """Wrap every do_* verb method of a BaseHTTPRequestHandler subclass
+    with the request counter + latency histogram (+ a trace span when
+    tracing is on). Wraps the do_* dispatch, not handle_one_request, so
+    keep-alive idle time between requests is never measured as request
+    latency. Returns the class for chaining."""
+    from seaweedfs_tpu.stats import trace
+
+    def _wrap(methname):
+        orig = getattr(handler_cls, methname)
+        verb = methname[3:].lower()
+        counter = RequestCounter.labels(role, verb)
+        histogram = RequestHistogram.labels(role, verb)
+        span_name = f"http.{role}.{verb}"
+
+        def wrapped(self):
+            t0 = time.perf_counter()
+            sp = trace.span(span_name, path=self.path) \
+                if trace.is_enabled() else trace.NOOP
+            sp.__enter__()
+            try:
+                orig(self)
+            finally:
+                sp.__exit__(None, None, None)
+                counter.inc()
+                histogram.observe(time.perf_counter() - t0)
+        wrapped.__name__ = methname
+        return wrapped
+
+    for methname in [m for m in dir(handler_cls) if m.startswith("do_")]:
+        setattr(handler_cls, methname, _wrap(methname))
+    return handler_cls
+
+
+def instrument_grpc_method(fn, role: str, method_name: str,
+                           server_streaming: bool = False):
+    """Wrap one gRPC servicer method with the request counter + latency
+    histogram (+ trace span). Used by rpc.generic_handler for every
+    service a server registers — the single gRPC instrumentation point.
+
+    Server-streaming methods count at stream START and get no latency
+    histogram or span: streams can live for the process lifetime
+    (SendHeartbeat, SubscribeMetadata), so an end-of-stream observation
+    would report nothing while the cluster runs and then poison
+    _sum/_count with one hours-long sample at shutdown."""
+    from seaweedfs_tpu.stats import trace
+    counter = RequestCounter.labels(role, method_name)
+    histogram = RequestHistogram.labels(role, method_name)
+    span_name = f"grpc.{role}.{method_name}"
+
+    if server_streaming:
+        def wrapped(request, context):
+            counter.inc()
+            yield from fn(request, context)
+    else:
+        def wrapped(request, context):
+            t0 = time.perf_counter()
+            sp = trace.span(span_name) if trace.is_enabled() else trace.NOOP
+            sp.__enter__()
+            try:
+                return fn(request, context)
+            finally:
+                sp.__exit__(None, None, None)
+                counter.inc()
+                histogram.observe(time.perf_counter() - t0)
+    wrapped.__name__ = method_name
+    return wrapped
 
 
 def start_metrics_server(port: int, registry: Registry = REGISTRY,
-                         ip: str = "") -> ThreadingHTTPServer:
+                         ip: str = "", role: str = "") -> ThreadingHTTPServer:
+    """Serve GET /metrics (Prometheus text), GET /healthz (role +
+    uptime JSON, the readiness probe tests/cluster_util.py polls) and
+    GET /debug/trace (Chrome trace-event JSON of the span ring). Any
+    other path is 404 — the handler defines only do_GET, so non-GET
+    methods get the stock 501."""
+    import json as _json
+
+    from seaweedfs_tpu.stats import trace
+
+    started = time.time()
+
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            body = registry.render().encode()
+            path = self.path.partition("?")[0]
+            if path == "/metrics":
+                body = registry.render().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/healthz":
+                body = _json.dumps({
+                    "role": role or "unknown",
+                    "uptime_seconds": round(time.time() - started, 3),
+                }).encode()
+                ctype = "application/json"
+            elif path == "/debug/trace":
+                body = trace.chrome_trace_json().encode()
+                ctype = "application/json"
+            else:
+                body = b"404 not found\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -251,17 +392,31 @@ def loop_pushing_metric(name: str, instance: str, addr: str,
                         interval_seconds: int,
                         registry: Registry = REGISTRY,
                         stop_event: Optional[threading.Event] = None) -> threading.Thread:
-    """Push-gateway loop (reference: stats/metrics.go:149)."""
+    """Push-gateway loop (reference: stats/metrics.go:149).
+
+    Push failures are counted (SeaweedFS_metrics_push_errors_total) and
+    logged once per state TRANSITION (ok->failing, failing->ok), never
+    per attempt — a down gateway must not log every interval forever.
+    """
+    from seaweedfs_tpu.util import wlog
+    log = wlog.logger("metrics")
     url = f"http://{addr}/metrics/job/{name}/instance/{instance}"
 
     def loop():
+        failing = False
         while not (stop_event and stop_event.is_set()):
             try:
                 req = urllib.request.Request(
                     url, data=registry.render().encode(), method="PUT")
                 urllib.request.urlopen(req, timeout=5).close()
-            except OSError:
-                pass
+                if failing:
+                    failing = False
+                    log.info("metrics push to %s recovered", addr)
+            except OSError as e:
+                MetricsPushErrorCounter.inc()
+                if not failing:
+                    failing = True
+                    log.warning("metrics push to %s failing: %s", addr, e)
             if stop_event:
                 if stop_event.wait(interval_seconds):
                     break

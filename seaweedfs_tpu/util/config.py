@@ -10,8 +10,15 @@ Values are addressed viper-style with dotted keys:
 from __future__ import annotations
 
 import os
-import tomllib
 from typing import Any, List, Optional
+
+try:
+    import tomllib
+except ImportError:  # py<3.11 without the tomli backport
+    try:
+        import tomli as tomllib  # type: ignore[no-redef]
+    except ImportError:
+        tomllib = None  # type: ignore[assignment]
 
 SEARCH_PATH = [
     ".",
@@ -50,12 +57,33 @@ class Configuration:
 
 def load_configuration(name: str, required: bool = False,
                        search_path: Optional[List[str]] = None) -> Configuration:
+    skipped = None
     for d in (search_path or SEARCH_PATH):
         p = os.path.join(d, name + ".toml")
         if os.path.isfile(p):
+            if tomllib is None:
+                # no TOML parser in this interpreter (py<3.11 without
+                # tomli): don't crash every server at startup, but a
+                # SKIPPED config can mean security silently off — warn
+                # loudly, never silently
+                from seaweedfs_tpu.util import wlog
+                wlog.logger("config").warning(
+                    "%s exists but this interpreter has no TOML parser "
+                    "(py<3.11 without tomli); IGNORING it — settings in "
+                    "it (including any [jwt]/[grpc] security sections) "
+                    "are NOT applied", p)
+                skipped = p
+                break
             with open(p, "rb") as f:
                 return Configuration(tomllib.load(f))
     if required:
+        if skipped:
+            # the file EXISTS — a "missing file" error would send the
+            # operator chasing search paths instead of the parser
+            raise RuntimeError(
+                f"{skipped} exists but cannot be parsed: this "
+                "interpreter has no TOML parser (python <3.11 without "
+                "the tomli backport)")
         raise FileNotFoundError(
             f"missing {name}.toml in {search_path or SEARCH_PATH}")
     return Configuration({})

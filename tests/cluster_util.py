@@ -51,7 +51,16 @@ class Cluster:
         self.master.start()
         self.volume_servers: List[VolumeServer] = []
         self.filer = None
+        # one metrics endpoint for the whole in-process cluster (the
+        # registry is process-global): /metrics for assertions,
+        # /healthz as the readiness probe polled below
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+        self.metrics_server = start_metrics_server(
+            0, ip="127.0.0.1", role="cluster")
+        self.metrics_url = "127.0.0.1:%d" % \
+            self.metrics_server.server_address[1]
         try:
+            self.wait_healthz()
             for i in range(n_volume_servers):
                 d = tmp_path / f"vol{i}"
                 d.mkdir(parents=True, exist_ok=True)
@@ -78,6 +87,23 @@ class Cluster:
             # suite's outer timeout kills it.
             self.stop()
             raise
+
+    def wait_healthz(self, timeout: float = 10.0) -> dict:
+        """Poll GET /healthz on the cluster metrics endpoint until it
+        answers (role + uptime JSON): the readiness gate that proves
+        the observability plane is serving before tests proceed."""
+        deadline = time.monotonic() + timeout
+        last: Exception = RuntimeError("never polled")
+        while time.monotonic() < deadline:
+            try:
+                with self.http(f"{self.metrics_url}/healthz",
+                               timeout=2.0) as r:
+                    return json.load(r)
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise TimeoutError(f"healthz at {self.metrics_url} never "
+                           f"answered: {last}")
 
     def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout
@@ -138,6 +164,8 @@ class Cluster:
         for vs in self.volume_servers:
             vs.stop()
         self.master.stop()
+        self.metrics_server.shutdown()
+        self.metrics_server.server_close()
         # drop pooled HTTP connections: this cluster's ports may be
         # reused by the next test's servers, and idle sockets otherwise
         # accumulate across the whole session

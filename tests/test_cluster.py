@@ -429,6 +429,56 @@ def test_shell_ec_encode_fuses_one_rpc_per_server(tmp_path, monkeypatch):
         c.stop()
 
 
+def test_metrics_expose_fleet_stages_after_ec_encode(tmp_path):
+    """ISSUE 2 acceptance: after an ec.encode on a running cluster,
+    /metrics exposes the fleet-stage families with non-zero samples,
+    and the fused generate RPC shows up in the uniform gRPC request
+    metrics. Readiness rides the new /healthz probe."""
+    from seaweedfs_tpu.shell import Shell
+
+    c = Cluster(tmp_path, n_volume_servers=1, volumes_per_server=8,
+                ec_encoder="numpy")
+    try:
+        assert c.wait_healthz()["role"] == "cluster"
+        blobs = []
+        for _ in range(12):
+            d = os.urandom(1024)
+            blobs.append((c.upload(d, collection="obs"), d))
+        vids = sorted({parse_fid(fid).volume_id for fid, _ in blobs})
+        assert len(vids) >= 2, f"need 2 volumes, uploads all hit {vids}"
+        va, vb = vids[:2]
+        out = Shell(c.master.url).run_command(
+            f"ec.encode -volumeId={va},{vb} -encoder numpy")
+        assert f"volume {va}: ec.encode done" in out
+        with c.http(f"{c.metrics_url}/metrics") as r:
+            text = r.read().decode()
+
+        def sample(line_prefix):
+            for line in text.splitlines():
+                if line.startswith(line_prefix):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"no sample starting {line_prefix!r}")
+
+        assert sample("SeaweedFS_fleet_dispatched_bytes_total") > 0
+        assert sample('SeaweedFS_fleet_stage_seconds_count'
+                      '{stage="read"}') > 0
+        assert sample('SeaweedFS_fleet_stage_seconds_count'
+                      '{stage="dispatch"}') > 0
+        assert sample('SeaweedFS_fleet_stage_seconds_count'
+                      '{stage="retire"}') > 0
+        assert sample('SeaweedFS_fleet_stage_seconds_count'
+                      '{stage="write"}') > 0
+        assert sample("SeaweedFS_fleet_dispatch_batch_spans_count") > 0
+        # the fused generate went through the shared gRPC decorator
+        assert sample('SeaweedFS_request_total{type="volumeServer",'
+                      'name="VolumeEcShardsGenerate"}') >= 1
+        assert sample('SeaweedFS_request_seconds_count'
+                      '{type="volumeServer",'
+                      'name="VolumeEcShardsGenerate"}') >= 1
+    finally:
+        c.stop()
+
+
 def test_admin_ui_pages(cluster):
     """Master and volume servers serve plain HTML status pages
     (reference server/*_ui)."""

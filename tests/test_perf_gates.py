@@ -143,6 +143,90 @@ def test_fleet_batched_encode_floor(tmp_path):
         f"fused={min(fused_s):.3f}s)"
 
 
+def test_tracing_disabled_overhead(tmp_path):
+    """Tracing must be zero-cost when off (ISSUE 2 tentpole contract).
+
+    Two gates. Micro: the disabled span() fast path is one flag check
+    returning a shared no-op — 200k calls must stay far under real
+    span cost (generous 5 us/call ceiling vs ~0.1 us measured).
+    Macro: the 8-volume fleet encode with the tracer merely present-
+    but-disabled (today's default — the PR 1 pipeline plus dormant
+    instrumentation) must stay within noise of the same encode with
+    instrumentation stubbed out entirely (the PR 1 baseline shape),
+    best-of-3 alternated per the VM-load methodology of the fleet
+    floor above."""
+    from seaweedfs_tpu.ec import fleet
+    from seaweedfs_tpu.native import rs_native
+    from seaweedfs_tpu.stats import trace
+
+    assert not trace.is_enabled()
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        trace.span("hot", vid=1)
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 5e-6, \
+        f"disabled span() costs {per_call * 1e6:.2f} us/call"
+
+    backend = "native" if rs_native.available() else "numpy"
+    rng = np.random.default_rng(17)
+    block = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    instrumented_bases, stubbed_bases = [], []
+    for v in range(8):
+        base = str(tmp_path / f"i{v}")
+        with open(base + ".dat", "wb") as f:
+            for _ in range(8):
+                f.write(block)
+        instrumented_bases.append(base)
+        twin = str(tmp_path / f"b{v}")
+        os.link(base + ".dat", twin + ".dat")
+        stubbed_bases.append(twin)
+
+    class _NullTimer:
+        def __init__(self, *a, **kw):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def token(self):
+            return None
+
+    real_timer = fleet._StageTimer
+
+    def run_instrumented():
+        t0 = time.perf_counter()
+        fleet.fleet_write_ec_files(instrumented_bases, backend=backend)
+        instrumented_s.append(time.perf_counter() - t0)
+
+    def run_stubbed():
+        fleet._StageTimer = _NullTimer
+        try:
+            t0 = time.perf_counter()
+            fleet.fleet_write_ec_files(stubbed_bases, backend=backend)
+            stubbed_s.append(time.perf_counter() - t0)
+        finally:
+            fleet._StageTimer = real_timer
+
+    instrumented_s, stubbed_s = [], []
+    for rep in range(3):  # alternate ORDER too: the first run of a
+        # pair eats page-cache warmup and any load spike's leading edge
+        first, second = (run_instrumented, run_stubbed) if rep % 2 \
+            else (run_stubbed, run_instrumented)
+        first()
+        second()
+    ratio = min(instrumented_s) / min(stubbed_s)
+    # within noise: single-shot fleet timings swing +-50% on shared
+    # VMs even best-of-3, so the gate catches only a real regression
+    # class (per-chunk instrumentation gone accidentally per-row/byte)
+    assert ratio <= 1.6, \
+        f"tracing-disabled fleet encode {ratio:.2f}x slower than " \
+        f"uninstrumented (instrumented={min(instrumented_s):.3f}s " \
+        f"stubbed={min(stubbed_s):.3f}s)"
+
+
 def test_storage_engine_microbench(tmp_path):
     """Raw storage-engine floors: the engine measured 36 us/write and
     17 us/read in round 4; 500/250 us floors catch an accidental

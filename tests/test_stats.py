@@ -1,0 +1,361 @@
+"""Exposition correctness + span tracer (stats/metrics.py, stats/trace.py).
+
+Exposition bugs are silent: Prometheus scrapes keep "working" while the
+parser drops or mis-buckets samples, so the text format's contracts —
+bucket cumulativity, +Inf == _count, label escaping — are pinned here
+byte-for-byte. The tracer tests pin the span model: zero-allocation
+no-op when disabled, same-thread nesting, cross-thread handoff tokens,
+Chrome trace-event export.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats.metrics import (
+    MetricsPushErrorCounter, Registry, loop_pushing_metric,
+    start_metrics_server)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.disable()
+    trace.clear()
+    yield
+    trace.disable()
+    trace.clear()
+
+
+# -- exposition ---------------------------------------------------------------
+
+class TestExposition:
+    def test_label_values_escaped(self):
+        """Backslash, double-quote and newline in label VALUES must be
+        escaped per the text-format spec or the exposition is
+        unparseable."""
+        reg = Registry()
+        c = reg.counter("esc_total", "h", ("path",))
+        c.labels('a"b').inc()
+        c.labels("c\\d").inc()
+        c.labels("e\nf").inc()
+        text = reg.render()
+        assert 'esc_total{path="a\\"b"} 1.0' in text
+        assert 'esc_total{path="c\\\\d"} 1.0' in text
+        assert 'esc_total{path="e\\nf"} 1.0' in text
+        assert "\ne\nf" not in text  # no raw newline mid-sample
+
+    def test_histogram_buckets_cumulative(self):
+        """le buckets are CUMULATIVE: each bucket counts every
+        observation <= its bound, +Inf equals _count, _sum is the
+        total."""
+        reg = Registry()
+        h = reg.histogram("lat", "h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = reg.render()
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1.0"} 3' in text
+        assert 'lat_bucket{le="10.0"} 4' in text
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_count 5" in text
+        assert "lat_sum 56.05" in text
+
+    def test_histogram_boundary_value_included(self):
+        """An observation exactly on a bucket bound lands IN that
+        bucket (le = less-or-equal)."""
+        reg = Registry()
+        h = reg.histogram("b", "h", buckets=(1.0, 2.0))
+        h.observe(1.0)
+        text = reg.render()
+        assert 'b_bucket{le="1.0"} 1' in text
+
+    def test_concurrent_observe_many_threads(self):
+        """observe() from many threads must lose no samples and keep
+        the cumulativity invariant (bucket counts monotone, +Inf ==
+        _count == total observations)."""
+        reg = Registry()
+        h = reg.histogram("conc", "h", ("op",), buckets=(0.5, 1.5))
+        child = h.labels("x")
+        n_threads, per_thread = 8, 2000
+
+        def work():
+            for i in range(per_thread):
+                child.observe((i % 3))  # 0, 1, 2 -> buckets 1, 2, inf
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread
+        assert child.count == total
+        # exact: 0 -> both buckets, 1 -> second bucket only, 2 -> inf
+        zeros = sum(1 for i in range(per_thread) if i % 3 == 0) * n_threads
+        ones = sum(1 for i in range(per_thread) if i % 3 == 1) * n_threads
+        assert child.counts[0] == zeros
+        assert child.counts[1] == zeros + ones
+        text = reg.render()
+        assert f'conc_bucket{{op="x",le="+Inf"}} {total}' in text
+        assert f'conc_count{{op="x"}} {total}' in text
+
+
+# -- metrics HTTP handler -----------------------------------------------------
+
+class TestMetricsEndpoint:
+    @pytest.fixture()
+    def srv(self):
+        reg = Registry()
+        reg.counter("up_total", "x").inc()
+        srv = start_metrics_server(0, registry=reg, ip="127.0.0.1",
+                                   role="volumeServer")
+        srv._test_port = srv.server_address[1]
+        yield srv
+        srv.shutdown()
+        srv.server_close()
+
+    def _get(self, srv, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{srv._test_port}{path}", timeout=5)
+
+    def test_metrics_ok(self, srv):
+        with self._get(srv, "/metrics") as r:
+            assert "up_total 1.0" in r.read().decode()
+
+    def test_healthz_role_and_uptime(self, srv):
+        with self._get(srv, "/healthz") as r:
+            doc = json.load(r)
+        assert doc["role"] == "volumeServer"
+        assert doc["uptime_seconds"] >= 0
+
+    def test_unknown_path_404(self, srv):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._get(srv, "/somewhere/else")
+        assert ei.value.code == 404
+
+    def test_debug_trace_serves_chrome_json(self, srv):
+        trace.enable()
+        with trace.span("unit.test"):
+            pass
+        with self._get(srv, "/debug/trace") as r:
+            doc = json.load(r)
+        names = [e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert "unit.test" in names
+
+
+# -- push loop ----------------------------------------------------------------
+
+def test_push_loop_counts_errors_and_logs_transitions(caplog):
+    """A dead gateway increments SeaweedFS_metrics_push_errors_total
+    every attempt but logs only the ok->failing TRANSITION, not every
+    attempt."""
+    import logging
+    reg = Registry()
+    before = MetricsPushErrorCounter.labels().value
+    stop = threading.Event()
+    with caplog.at_level(logging.WARNING, logger="seaweedfs_tpu.metrics"):
+        t = loop_pushing_metric("job", "inst", "127.0.0.1:1",  # closed port
+                                interval_seconds=0.05, registry=reg,
+                                stop_event=stop)
+        deadline = time.monotonic() + 10
+        while MetricsPushErrorCounter.labels().value < before + 3 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=5)
+    assert MetricsPushErrorCounter.labels().value >= before + 3
+    failing_logs = [r for r in caplog.records
+                    if "metrics push" in r.getMessage()
+                    and "failing" in r.getMessage()]
+    assert len(failing_logs) == 1, \
+        f"expected ONE transition log, got {len(failing_logs)}"
+
+
+# -- tracer -------------------------------------------------------------------
+
+class TestTrace:
+    def test_disabled_is_shared_noop(self):
+        """Disabled tracing allocates nothing: every span() call
+        returns the same no-op object and records nothing."""
+        assert trace.span("a") is trace.span("b") is trace.NOOP
+        with trace.span("c", k=1):
+            pass
+        assert trace.spans() == []
+        assert trace.handoff() is None
+
+    def test_same_thread_nesting(self):
+        trace.enable()
+        with trace.span("outer") as outer:
+            with trace.span("inner") as inner:
+                pass
+        got = {s.name: s for s in trace.spans()}
+        assert got["inner"].parent_id == outer.id
+        assert got["outer"].parent_id is None
+        assert got["inner"].dur <= got["outer"].dur
+
+    def test_cross_thread_handoff(self):
+        """A handoff token parents a span opened on ANOTHER thread
+    under the minting span — the pipeline-stage contract."""
+        trace.enable()
+        seen = {}
+
+        def stage_two(token):
+            with trace.span("stage2", parent=token) as s:
+                seen["tid"] = s.tid
+
+        with trace.span("stage1") as s1:
+            tok = s1.token()
+            t = threading.Thread(target=stage_two, args=(tok,))
+            t.start()
+            t.join()
+        got = {s.name: s for s in trace.spans()}
+        assert got["stage2"].parent_id == got["stage1"].id
+        assert got["stage2"].tid != got["stage1"].tid
+
+    def test_ring_is_bounded(self):
+        trace.enable(capacity=16)
+        for i in range(100):
+            with trace.span("s", i=i):
+                pass
+        items = trace.spans()
+        assert len(items) == 16
+        assert items[-1].tags["i"] == 99  # newest kept, oldest evicted
+        trace.enable(capacity=trace.DEFAULT_CAPACITY)
+
+    def test_chrome_trace_shape(self):
+        trace.enable()
+        with trace.span("alpha", vid=3):
+            pass
+        doc = json.loads(trace.chrome_trace_json())
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs and xs[-1]["name"] == "alpha"
+        assert xs[-1]["args"]["vid"] == 3
+        assert xs[-1]["dur"] >= 0
+        metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        assert any(m["name"] == "thread_name" for m in metas)
+
+    def test_rollup_and_busy_union(self):
+        trace.enable()
+        t0 = time.perf_counter()
+        with trace.span("work"):
+            time.sleep(0.05)
+        with trace.span("work"):
+            time.sleep(0.02)
+        t1 = time.perf_counter()
+        roll = trace.rollup()
+        assert roll["work"]["count"] == 2
+        assert roll["work"]["total_s"] >= 0.06
+        covered = trace.busy_union_s(trace.spans(), t0, t1,
+                                     prefixes=("work",))
+        assert covered >= 0.06
+        assert covered <= (t1 - t0) + 1e-6
+
+    def test_busy_union_merges_overlaps(self):
+        """Two spans over the same interval must not double-count."""
+        a = trace.Span("x", None, {})
+        a.t0, a.dur = 10.0, 1.0
+        b = trace.Span("x", None, {})
+        b.t0, b.dur = 10.5, 1.0
+        assert abs(trace.busy_union_s([a, b], 10.0, 12.0) - 1.5) < 1e-9
+
+
+# -- fleet pipeline metrics ---------------------------------------------------
+
+def test_fleet_encode_populates_stage_metrics(tmp_path):
+    """One fleet encode must leave non-zero samples in every
+    fleet-stage family (the acceptance gate: stage attribution for
+    free on any ec.encode)."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import fleet
+    from seaweedfs_tpu.stats.metrics import (
+        REGISTRY, FleetDispatchedBytesCounter)
+
+    rng = np.random.default_rng(23)
+    bases = []
+    for v in range(3):
+        base = str(tmp_path / f"m{v}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+                    .tobytes())
+        bases.append(base)
+    bytes_before = FleetDispatchedBytesCounter.labels().value
+    fleet.fleet_write_ec_files(bases, backend="numpy")
+    assert FleetDispatchedBytesCounter.labels().value >= \
+        bytes_before + 3 * (2 << 20)
+    text = REGISTRY.render()
+    assert 'SeaweedFS_fleet_stage_seconds_bucket{stage="read"' in text
+    assert 'SeaweedFS_fleet_stage_seconds_count{stage="retire"}' in text
+    assert 'SeaweedFS_fleet_stage_seconds_count{stage="write"}' in text
+    assert 'SeaweedFS_fleet_stage_seconds_count{stage="dispatch"}' in text
+    assert "SeaweedFS_fleet_dispatch_batch_spans_count" in text
+    assert "SeaweedFS_fleet_reader_queue_depth" in text
+    assert "SeaweedFS_fleet_writer_lane_backlog" in text
+
+
+def test_fleet_encode_traced_spans_cover_stages(tmp_path):
+    """With tracing on, a fleet encode emits spans for every stage,
+    parented under fleet.encode, and the union of stage spans covers
+    most of the wall time (the bench --trace contract, held loosely
+    here: a tiny encode on a loaded CI VM has startup overhead a real
+    bench run amortizes)."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import fleet
+
+    rng = np.random.default_rng(29)
+    bases = []
+    for v in range(4):
+        base = str(tmp_path / f"t{v}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, 2 << 20, dtype=np.uint8)
+                    .tobytes())
+        bases.append(base)
+    trace.enable()
+    t0 = time.perf_counter()
+    fleet.fleet_write_ec_files(bases, backend="numpy")
+    t1 = time.perf_counter()
+    items = trace.spans()
+    names = {s.name for s in items}
+    for stage in ("fleet.encode", "fleet.read", "fleet.dispatch",
+                  "fleet.rs", "fleet.retire", "fleet.write"):
+        assert stage in names, f"missing {stage} spans (got {names})"
+    root = next(s for s in items if s.name == "fleet.encode")
+    reads = [s for s in items if s.name == "fleet.read"]
+    assert all(r.parent_id == root.id for r in reads)
+    covered = trace.busy_union_s(
+        items, t0, t1, prefixes=("fleet.read", "fleet.dispatch",
+                                 "fleet.rs", "fleet.retire",
+                                 "fleet.write"))
+    assert covered / (t1 - t0) >= 0.5, \
+        f"stage spans cover only {covered / (t1 - t0):.0%} of wall"
+
+
+def test_fleet_encode_shards_identical_with_tracing(tmp_path):
+    """Tracing must be purely observational: shard bytes with tracing
+    enabled match a serial untraced encode."""
+    import numpy as np
+
+    from seaweedfs_tpu.ec import encoder, fleet
+
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, (3 << 20) + 123, dtype=np.uint8).tobytes()
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for base in (a, b):
+        with open(base + ".dat", "wb") as f:
+            f.write(data)
+    encoder.write_ec_files(a, backend="numpy")
+    trace.enable()
+    fleet.fleet_write_ec_files([b], backend="numpy")
+    for sid in range(14):
+        pa = encoder.shard_file_name(a, sid)
+        pb = encoder.shard_file_name(b, sid)
+        assert open(pa, "rb").read() == open(pb, "rb").read(), \
+            f"shard {sid} diverged under tracing"
