@@ -284,7 +284,72 @@ def fleet_trace_bench(out_path: str = "bench_trace.json") -> dict:
     }
 
 
+def scrub_verify_sweep(batches=(1, 8)) -> dict:
+    """--scrub mode: integrity-verify throughput of the scrub path.
+
+    The scrub scanner's compute is `fleet_verify_ec_files` — re-encode
+    data shards through the fused dispatcher, compare against stored
+    parity. This sweep measures end-to-end verify GB/s over real EC
+    files (setup cost — the initial encode — excluded), fused many-
+    volume verify vs one scheduler per volume, same best-of-N
+    alternation discipline as fleet_batch_sweep. GB/s counts the .dat
+    bytes whose integrity each pass establishes.
+    """
+    import tempfile
+
+    from seaweedfs_tpu.ec import encoder as enc
+    from seaweedfs_tpu.ec import fleet
+
+    backend = os.environ.get("BENCH_FLEET_BACKEND") or _cpu_backend()
+    vol_mb = int(os.environ.get("BENCH_SCRUB_VOL_MB", "8"))
+    repeats = int(os.environ.get("BENCH_SCRUB_REPEATS", "5"))
+    vol_bytes = vol_mb << 20
+    block = np.random.default_rng(9).integers(
+        0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    sweep = []
+    for n in batches:
+        with tempfile.TemporaryDirectory() as d:
+            bases = []
+            for v in range(n):
+                base = os.path.join(d, f"v{v}")
+                with open(base + ".dat", "wb") as f:
+                    written = 0
+                    while written < vol_bytes:
+                        written += f.write(block[: vol_bytes - written])
+                enc.write_ec_files(base, backend=backend)
+                bases.append(base)
+            serial_s, fused_s = [], []
+            clean = True
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                for base in bases:
+                    r = fleet.fleet_verify_ec_files([base],
+                                                    backend=backend)
+                    clean &= all(v.clean for v in r.values())
+                serial_s.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                r = fleet.fleet_verify_ec_files(bases, backend=backend)
+                clean &= all(v.clean for v in r.values())
+                fused_s.append(time.perf_counter() - t0)
+        total_gb = n * vol_bytes / 1e9
+        sweep.append({
+            "batch_volumes": n,
+            "serial_gbps": round(total_gb / min(serial_s), 3),
+            "fused_gbps": round(total_gb / min(fused_s), 3),
+            "speedup": round(min(serial_s) / min(fused_s), 3),
+            "all_clean": clean,
+        })
+    return {"metric": "scrub_verify_gbps", "unit": "GB/s",
+            "value": sweep[-1]["fused_gbps"],
+            "volume_mb": vol_mb, "backend": backend, "sweep": sweep}
+
+
 def main() -> None:
+    if "--scrub" in sys.argv:
+        # scrub mode is host-pipeline only: verify throughput of the
+        # integrity scanner, not the kernel headline
+        print(json.dumps(scrub_verify_sweep()), flush=True)
+        return
     if "--trace" in sys.argv:
         # trace mode is host-pipeline only (no TPU needed): stage
         # attribution of the fleet scheduler, not the kernel headline

@@ -79,6 +79,14 @@ def _master_parser() -> argparse.ArgumentParser:
     p.add_argument("-peers", default="",
                    help="comma-separated ip:port of ALL masters "
                         "(including this one) for raft HA")
+    p.add_argument("-scrub.intervalSeconds", dest="scrub_interval_s",
+                   type=float, default=0.0,
+                   help="open one scrub window per volume server every "
+                        "N seconds, staggered across the topology "
+                        "(0 = disabled)")
+    p.add_argument("-scrubMBps", dest="scrub_throttle_mbps", type=float,
+                   default=0.0,
+                   help="IO budget handed to each scheduled scrub")
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
@@ -108,6 +116,8 @@ def _build_master(opts):
         peers=peers,
         maintenance_scripts=list(scripts),
         maintenance_interval_s=float(sleep_minutes) * 60,
+        scrub_interval_s=opts.scrub_interval_s,
+        scrub_throttle_mbps=opts.scrub_throttle_mbps,
         sequencer_type=conf.get_string("master.sequencer.type", "memory"),
         sequencer_node_id=conf.get("master.sequencer.node_id"),
         sequencer_etcd_urls=conf.get_string(
@@ -143,6 +153,14 @@ def _volume_parser() -> argparse.ArgumentParser:
                    default=5.0)
     p.add_argument("-compactionMBps", dest="compaction_mbps", type=float,
                    default=0.0)
+    p.add_argument("-scrubMBps", dest="scrub_mbps", type=float,
+                   default=0.0,
+                   help="IO budget for the background integrity scrub "
+                        "(0 = unthrottled)")
+    p.add_argument("-scrub.intervalSeconds", dest="scrub_interval_s",
+                   type=float, default=0.0,
+                   help="re-scrub every N seconds (0 = only on demand "
+                        "via volume.scrub / the master scheduler)")
     p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
                    choices=["auto", "jax", "native", "numpy", "pallas"])
     p.add_argument("-index", dest="needle_map_kind", default="memory",
@@ -186,7 +204,9 @@ def _build_volume(opts):
         pulse_seconds=opts.pulse_seconds, ec_encoder=opts.ec_encoder,
         compaction_mbps=opts.compaction_mbps,
         storage_backends=_storage_backend_conf(),
-        needle_map_kind=opts.needle_map_kind)
+        needle_map_kind=opts.needle_map_kind,
+        scrub_mbps=opts.scrub_mbps,
+        scrub_interval_s=opts.scrub_interval_s)
 
 
 @command("volume", "start a volume server (data plane)")

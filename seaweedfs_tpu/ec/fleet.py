@@ -48,6 +48,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -91,7 +92,8 @@ _LANE_QUEUE = 4
 # lock per call, and a stage interval closes for every chunk-sized
 # unit of work.
 _STAGE_HIST = {s: FleetStageSecondsHistogram.labels(s)
-               for s in ("read", "dispatch", "rs", "retire", "write")}
+               for s in ("read", "dispatch", "rs", "retire", "write",
+                         "verify")}
 
 
 class _StageTimer:
@@ -664,3 +666,200 @@ def _fleet_rebuild_group(present: List[int], missing: List[int],
         finally:
             dispatcher.close()
             root.__exit__(None, None, None)
+
+
+# --- fleet verify ------------------------------------------------------------
+
+@dataclass
+class VerifyResult:
+    """Outcome of verifying one volume's EC files.
+
+    parity_mismatch maps a parity shard id (10..13) to its count of
+    bytes that differ from the re-encoded parity; first_mismatch holds
+    the first differing shard offset per shard. `missing` lists shard
+    files absent on disk — those are known damage (the rebuild path's
+    job), not verification subjects. A volume with any data shard
+    missing cannot be re-encoded and is reported with verified=False.
+    """
+
+    parity_mismatch: Dict[int, int] = field(default_factory=dict)
+    first_mismatch: Dict[int, int] = field(default_factory=dict)
+    missing: List[int] = field(default_factory=list)
+    parity_checked: List[int] = field(default_factory=list)
+    bytes_verified: int = 0
+    spans: int = 0
+    verified: bool = True
+
+    @property
+    def clean(self) -> bool:
+        return self.verified and not self.parity_mismatch \
+            and not self.missing
+
+
+def fleet_verify_ec_files(base_names: Sequence[str], backend: str = "auto",
+                          chunk: Optional[int] = None,
+                          readers: int = FLEET_READERS,
+                          depth: int = FLEET_DEPTH,
+                          encoders: int = FLEET_ENCODERS,
+                          device=None,
+                          throttler=None) -> Dict[str, "VerifyResult"]:
+    """Verify EC stripe consistency for MANY volumes in one fused pass.
+
+    The scrub scanner's compute path: data shards are re-encoded
+    through the same fleet dispatcher as `fleet_write_ec_files` —
+    spans from all volumes fuse into shared [B, 10, span] RS
+    dispatches — and the recomputed parity is compared byte-for-byte
+    against the stored .ec10-13, so verification throughput rides the
+    TPU/mesh encode path instead of a host loop. Nothing on disk is
+    touched; mismatches are reported per parity shard for the repair
+    planner to classify (a corrupt DATA shard surfaces here as all
+    four parity shards disagreeing at the same offsets — see
+    scrub/planner.py).
+
+    `throttler` (util.throttler.Throttler) paces the read side so a
+    background scrub stays inside its IO budget.
+    """
+    if chunk is None:
+        chunk = default_chunk_for(backend)
+    results: Dict[str, VerifyResult] = {}
+    fleet: List[Tuple[str, int, List[int]]] = []  # (base, size, parity ids)
+    for base in base_names:
+        r = VerifyResult()
+        results[base] = r
+        present = [i for i in range(TOTAL_SHARDS)
+                   if os.path.exists(shard_file_name(base, i))]
+        r.missing = [i for i in range(TOTAL_SHARDS) if i not in present]
+        data_present = [i for i in present if i < DATA_SHARDS]
+        parity_present = [i for i in present if i >= DATA_SHARDS]
+        if len(data_present) < DATA_SHARDS or not parity_present:
+            # can't re-encode without every data shard (or compare
+            # without any parity): known damage, rebuild's job
+            r.verified = False
+            continue
+        r.parity_checked = parity_present
+        shard_size = os.path.getsize(shard_file_name(base, 0))
+        fleet.append((base, shard_size, parity_present))
+    if not fleet:
+        return results
+    # span: the per-volume slice of one ~chunk-sized fused dispatch,
+    # capped at the largest shard so small fleets don't read (and
+    # RS-encode) chunk-sized slabs of zero padding per 100KB shard
+    span = max(1, min(chunk // max(1, len(fleet)),
+                      max(size for _, size, _ in fleet)))
+    vols = [(_VolState(base, size, -(-size // span) if size else 0, tag),
+             parity)
+            for tag, (base, size, parity) in enumerate(fleet)]
+
+    def gen_spans():
+        for v, row0, _rows in _round_robin_spans([v for v, _ in vols], 1):
+            yield v, row0 * span
+
+    parity_by_tag = {v.tag: parity for v, parity in vols}
+    dispatcher = _Dispatcher(ReedSolomon(backend=backend), device=device,
+                             encoders=encoders)
+    pool = ThreadPoolExecutor(max_workers=max(1, readers),
+                              thread_name_prefix="fleet-read")
+    pipe = TaggedPipeline(depth=depth)
+    gen = gen_spans()
+    inflight: deque = deque()
+    per_batch = len(fleet)
+    prefetch = max(readers, 2 * per_batch)
+    root = trace.span("fleet.verify", volumes=len(fleet), backend=backend)
+    root.__enter__()
+    token = root.token()
+    data_present = list(range(DATA_SHARDS))
+
+    def fill() -> None:
+        while len(inflight) < prefetch:
+            nxt = next(gen, None)
+            if nxt is None:
+                break
+            v, offset = nxt
+            if throttler is not None:
+                # pace on the read side: one span costs 10 data reads
+                # plus the parity reads the compare will issue
+                throttler.maybe_slowdown(
+                    (DATA_SHARDS + len(parity_by_tag[v.tag])) * span)
+            inflight.append((v, offset, pool.submit(
+                _read_present_span, v.base, data_present, v.dat_size,
+                offset, span, token)))
+            FleetReaderQueueGauge.inc()  # delta: concurrent-safe sum
+
+    # parity fds cached per volume for the whole pass: each volume's
+    # compares run FIFO on ITS writer lane (single reader per fd), and
+    # per-span open/close would cost thousands of syscalls per volume
+    # once large fleets shrink the span. Populated INSIDE the
+    # try/finally below: an open() racing a concurrent shard delete
+    # must still tear down the pools/span and close earlier fds.
+    parity_fds: Dict[str, Dict[int, object]] = {}
+
+    def compare(v: _VolState, offset: int, out: np.ndarray) -> None:
+        """Runs on v's writer lane: recomputed parity [1, 4, span] (or
+        [4, span] from the host pool) vs the stored parity slices."""
+        with _StageTimer("verify", vol=os.path.basename(v.base)):
+            parity = out[0] if out.ndim == 3 else out
+            valid = min(span, v.dat_size - offset)
+            r = results[v.base]
+            for sid in parity_by_tag[v.tag]:
+                f = parity_fds[v.base][sid]
+                f.seek(offset)
+                stored = f.read(valid)
+                stored_arr = np.frombuffer(stored, dtype=np.uint8)
+                row = parity[sid - DATA_SHARDS][:len(stored_arr)]
+                diff = np.nonzero(row != stored_arr)[0]
+                if len(diff):
+                    r.parity_mismatch[sid] = \
+                        r.parity_mismatch.get(sid, 0) + len(diff)
+                    # spans retire in offset order on this volume's
+                    # lane, so the first recorded hit is the lowest
+                    r.first_mismatch.setdefault(sid, offset + int(diff[0]))
+                if len(stored_arr) < valid:
+                    # a truncated parity shard is missing bytes the
+                    # data shards say should exist: every absent byte
+                    # is a mismatch, not a free pass
+                    r.parity_mismatch[sid] = \
+                        r.parity_mismatch.get(sid, 0) + \
+                        (valid - len(stored_arr))
+                    r.first_mismatch.setdefault(
+                        sid, offset + len(stored_arr))
+            r.bytes_verified += DATA_SHARDS * valid
+            r.spans += 1
+
+    def flush(pack) -> None:
+        with _StageTimer("dispatch", batch=len(pack)):
+            handle = dispatcher.encode(
+                [a[np.newaxis] for _, _, a in pack])
+        FleetDispatchBatchHistogram.observe(len(pack))
+        FleetDispatchedBytesCounter.inc(
+            float(sum(a.nbytes for _, _, a in pack)))
+        pipe.submit(handle, [
+            (v.tag, functools.partial(compare, v, offset))
+            for v, offset, _ in pack])
+
+    try:
+        for v, parity in vols:
+            fds = parity_fds[v.base] = {}
+            for sid in parity:  # incremental: no fd lost to a partial
+                fds[sid] = open(shard_file_name(v.base, sid), "rb")
+        fill()
+        pack = []
+        while inflight:
+            item = inflight.popleft()
+            FleetReaderQueueGauge.dec()
+            pack.append((item[0], item[1], item[2].result()))
+            fill()
+            if len(pack) >= per_batch or not inflight:
+                flush(pack)
+                pack = []
+    finally:
+        FleetReaderQueueGauge.dec(len(inflight))  # error path leftovers
+        pool.shutdown(wait=True)
+        try:
+            pipe.drain()  # may re-raise the latched pipeline error
+        finally:
+            dispatcher.close()
+            for fds in parity_fds.values():
+                for f in fds.values():
+                    f.close()
+            root.__exit__(None, None, None)
+    return results

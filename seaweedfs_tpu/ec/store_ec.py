@@ -202,6 +202,22 @@ def delete_ec_needle(store: Store, vid: int, n: Needle) -> None:
     ecv.delete_needle(n.id)
 
 
+def scrub_ec_volume(store: Store, vid: int, backend: str = "auto",
+                    mbps: float = 0.0):
+    """Targeted integrity scrub of ONE mounted EC volume: needle sweep,
+    stripe verify, and (when damaged) quarantine + reconstruction —
+    the store-level form of the daemon's whole-store pass, for ad-hoc
+    operator checks. Returns the scrub PassResult."""
+    from seaweedfs_tpu.scrub import ScrubDaemon
+    if store.find_ec_volume(vid) is None:
+        raise EcShardNotFound(f"ec volume {vid} not mounted")
+    # export_lag=False: a throwaway targeted pass must not hijack the
+    # process-global scan-lag gauge from the server's own daemon
+    daemon = ScrubDaemon(store, backend=backend, mbps=mbps,
+                         export_lag=False)
+    return daemon.run_pass(volume_ids=[vid])
+
+
 def ec_shards_to_volume(store: Store, vid: int, collection: str = "",
                         backend: str = "auto",
                         large_block: int = encoder.LARGE_BLOCK_SIZE,

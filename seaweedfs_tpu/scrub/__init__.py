@@ -1,0 +1,36 @@
+"""Background integrity scrub & self-repair.
+
+SeaweedFS trusts bytes once written: needle CRCs are checked on reads,
+EC shards never again after encode — the latent-sector-error failure
+mode warm stores guard against with continuous scrubbing (f4-style).
+This package closes that gap with three parts:
+
+  scanner   walks mounted volumes and EC volumes at a throttled pace,
+            recomputing needle CRCs and re-encoding EC data shards
+            through the fleet dispatcher (ec/fleet.py) in fused
+            [B, 10, chunk] batches, comparing against stored parity.
+  planner   classifies damage (bad parity shard vs bad data shard vs
+            unrecoverable), quarantines corrupt files with a .corrupt
+            rename, and reconstructs shards via the fleet rebuild path
+            (needles come back from replicas).
+  daemon    the control plane: a background thread per volume server
+            with start/pause/status, wired to VolumeScrubStart/Pause/
+            Status RPCs, the HTTP /status page, the master's staggered
+            scheduler, and the `volume.scrub` shell command.
+
+Everything is instrumented with the PR 2 primitives: scrub.pass/scan/
+verify/repair spans and the SeaweedFS_scrub_* metric families.
+"""
+
+from seaweedfs_tpu.scrub.daemon import ScrubDaemon, PassResult
+from seaweedfs_tpu.scrub.planner import (EcDamage, classify_ec_damage,
+                                         repair_ec_volume, repair_needle)
+from seaweedfs_tpu.scrub.scanner import (EcNeedleScan, NeedleScan,
+                                         scan_ec_volume_needles,
+                                         scan_volume)
+
+__all__ = [
+    "ScrubDaemon", "PassResult",
+    "EcDamage", "classify_ec_damage", "repair_ec_volume", "repair_needle",
+    "EcNeedleScan", "NeedleScan", "scan_ec_volume_needles", "scan_volume",
+]

@@ -65,6 +65,20 @@ class AdminLock:
                 self._ts_ns = 0
 
 
+def plan_scrub_stagger(urls: List[str],
+                       interval_s: float) -> List[tuple]:
+    """Spread one scrub window over the fleet: [(url, wait_before_s)].
+
+    Node i starts interval_s/n after node i-1, so the whole topology
+    is covered once per interval while at most one node begins its
+    scan at any instant — pure over the url list, unit-testable
+    without a cluster (the house planning-function pattern)."""
+    if not urls:
+        return []
+    gap = interval_s / len(urls)
+    return [(url, 0.0 if i == 0 else gap) for i, url in enumerate(urls)]
+
+
 class MasterServer:
     SEQ_WATERMARK_GAP = 10000  # ids raft-committed ahead of allocation
 
@@ -78,6 +92,8 @@ class MasterServer:
                  raft_election_timeout: float = 0.5,
                  maintenance_scripts: Optional[List[str]] = None,
                  maintenance_interval_s: float = 17 * 60,
+                 scrub_interval_s: float = 0.0,
+                 scrub_throttle_mbps: float = 0.0,
                  sequencer_type: str = "memory",
                  sequencer_node_id: Optional[int] = None,
                  sequencer_etcd_urls: str = "127.0.0.1:2379"):
@@ -145,6 +161,13 @@ class MasterServer:
         self.maintenance_interval_s = maintenance_interval_s
         self._maint_thread: Optional[threading.Thread] = None
         self._maint_wake = threading.Event()
+        # leader-only scrub scheduler: every interval, each volume
+        # server gets one VolumeScrubStart, staggered across the
+        # window so the fleet never scrubs in lockstep (0 = disabled)
+        self.scrub_interval_s = scrub_interval_s
+        self.scrub_throttle_mbps = scrub_throttle_mbps
+        self._scrub_thread: Optional[threading.Thread] = None
+        self._scrub_wake = threading.Event()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -174,6 +197,11 @@ class MasterServer:
                 target=self._maintenance_loop, name="master-maintenance",
                 daemon=True)
             self._maint_thread.start()
+        if self.scrub_interval_s > 0:
+            self._scrub_thread = threading.Thread(
+                target=self._scrub_loop, name="master-scrub",
+                daemon=True)
+            self._scrub_thread.start()
         log.info("master %s started (grpc :%d)", self.url,
                  self.port + rpc.GRPC_PORT_OFFSET)
 
@@ -181,6 +209,7 @@ class MasterServer:
         log.info("master %s stopping", self.url)
         self._stopping = True
         self._maint_wake.set()
+        self._scrub_wake.set()
         self.raft.stop()
         self._save_sequence()
         if self._http_server:
@@ -246,6 +275,54 @@ class MasterServer:
     def run_maintenance_now(self) -> None:
         """Test/ops hook: trigger one cron pass immediately."""
         self._maint_wake.set()
+
+    # -- scrub scheduler -------------------------------------------------------
+
+    def _scrub_loop(self) -> None:
+        """Leader-only: once per scrub_interval_s, start a scrub pass
+        on every volume server, staggered across the window so disks
+        fleet-wide never take the scan IO at the same instant. The
+        stagger waits are spent INSIDE the interval window (the tail
+        wait covers only the remainder), so each node's period is the
+        configured interval, not interval + stagger."""
+        while not self._stopping:
+            cycle_start = time.monotonic()
+            if self.raft.is_leader:
+                urls = sorted(n.url for n in self.topo.nodes())
+                for url, offset in plan_scrub_stagger(
+                        urls, self.scrub_interval_s):
+                    if offset > 0:
+                        self._scrub_wake.wait(timeout=offset)
+                        self._scrub_wake.clear()
+                    if self._stopping or not self.raft.is_leader:
+                        break
+                    self._start_scrub_on(url)
+            if self._stopping:
+                return
+            remainder = self.scrub_interval_s - \
+                (time.monotonic() - cycle_start)
+            if remainder > 0:
+                self._scrub_wake.wait(timeout=remainder)
+                self._scrub_wake.clear()
+
+    def _start_scrub_on(self, url: str) -> bool:
+        try:
+            resp = volume_stub(url).VolumeScrubStart(
+                volume_server_pb2.VolumeScrubStartRequest(
+                    throttle_mbps=self.scrub_throttle_mbps))
+            if resp.started:
+                log.info("scrub window opened on %s", url)
+            return resp.started
+        except grpc.RpcError as e:
+            log.warning("scrub start on %s failed: %s", url,
+                        getattr(e, "code", lambda: e)())
+            return False
+
+    def scrub_all_now(self) -> List[str]:
+        """Test/ops hook: fire VolumeScrubStart on every node now
+        (no stagger). Returns the urls that accepted."""
+        return [n.url for n in self.topo.nodes()
+                if self._start_scrub_on(n.url)]
 
     # -- raft ------------------------------------------------------------------
 

@@ -37,10 +37,12 @@ from seaweedfs_tpu.server import convert
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage import vacuum as vacuum_mod
 from seaweedfs_tpu.storage import volume_backup, volume_tier
+from seaweedfs_tpu.scrub import ScrubDaemon
 from seaweedfs_tpu.storage.backend import BackendError
 from seaweedfs_tpu.storage.needle import (FLAG_IS_CHUNK_MANIFEST,
                                           FLAG_IS_COMPRESSED,
-                                          CookieMismatch, Needle,
+                                          CookieMismatch,
+                                          DataCorruptionError, Needle,
                                           NeedleError)
 from seaweedfs_tpu.storage.store import Store
 from seaweedfs_tpu.storage.superblock import TTL
@@ -66,7 +68,9 @@ class VolumeServer:
                  pulse_seconds: float = 5.0, ec_encoder: str = "auto",
                  compaction_mbps: float = 0.0,
                  storage_backends: Optional[dict] = None,
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "memory",
+                 scrub_mbps: float = 0.0,
+                 scrub_interval_s: float = 0.0):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -87,6 +91,14 @@ class VolumeServer:
         self.store = Store(directories, max_volume_counts, ip=ip, port=port,
                            public_url=public_url,
                            needle_map_kind=needle_map_kind)
+        # background integrity scrub: costs nothing (no thread, no IO)
+        # until started — by RPC, by the master's staggered scheduler,
+        # or at boot when -scrub.intervalSeconds is set
+        self.scrub = ScrubDaemon(
+            self.store, mbps=scrub_mbps, backend=ec_encoder,
+            interval_s=scrub_interval_s,
+            replica_fetch=self._fetch_needle_from_replica)
+        self.scrub_interval_s = scrub_interval_s
         self.volume_size_limit = 30 << 30
         self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
         self._ec_locations: Dict[int, Tuple[float, Dict[int, List[str]]]] = {}
@@ -119,6 +131,8 @@ class VolumeServer:
             target=self._heartbeat_loop, name=f"heartbeat-{self.port}",
             daemon=True)
         self._hb_thread.start()
+        if self.scrub_interval_s > 0:
+            self.scrub.start()
         log.info("volume server %s:%d started (grpc :%d, dirs %s)",
                  self.ip, self.port, self.port + rpc.GRPC_PORT_OFFSET,
                  [loc.directory for loc in self.store.locations])
@@ -126,6 +140,7 @@ class VolumeServer:
     def stop(self) -> None:
         log.info("volume server %s:%d stopping", self.ip, self.port)
         self._stopping = True
+        self.scrub.stop()
         self._hb_wake.set()
         if self._hb_call is not None:
             self._hb_call.cancel()
@@ -719,6 +734,43 @@ class VolumeServer:
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeEcShardsToVolumeResponse()
 
+    # -- gRPC: scrub control plane ---------------------------------------------
+
+    def VolumeScrubStart(self, request, context):
+        started = self.scrub.start(
+            volume_ids=list(request.volume_ids) or None,
+            throttle_mbps=request.throttle_mbps or None,
+            full=request.full)
+        return volume_server_pb2.VolumeScrubStartResponse(started=started)
+
+    def VolumeScrubPause(self, request, context):
+        return volume_server_pb2.VolumeScrubPauseResponse(
+            paused=self.scrub.pause())
+
+    def VolumeScrubStatus(self, request, context):
+        return volume_server_pb2.VolumeScrubStatusResponse(
+            **self.scrub.status())
+
+    def _fetch_needle_from_replica(self, vid: int, corrupt: Needle):
+        """Scrub repair source: the raw stored payload of one needle
+        from any OTHER replica. Accept-Encoding gzip keeps a
+        compressed needle's stored bytes as stored; cm=false stops the
+        replica from resolving a chunk manifest into its chunks. The
+        planner validates whatever comes back against the local
+        record's own stored CRC, so a stale or corrupt replica copy is
+        rejected, never written."""
+        fid = f"{vid},{corrupt.id:x}{corrupt.cookie:08x}"
+        for url in self._other_replicas(vid):
+            try:
+                resp = http_client.request(
+                    "GET", f"{url}/{fid}?cm=false",
+                    headers={"Accept-Encoding": "gzip"}, timeout=30)
+            except OSError:
+                continue
+            if resp.status == 200:
+                return resp.body
+        return None
+
     # -- gRPC: status ----------------------------------------------------------
 
     def VolumeServerStatus(self, request, context):
@@ -1061,6 +1113,15 @@ def _make_http_handler(vs: VolumeServer):
             except CookieMismatch:
                 self._reply(404)
                 return
+            except DataCorruptionError as e:
+                # corrupt is not missing: a 404 would tell the client
+                # the blob never existed; 500 + the scrub counter flags
+                # it for repair instead
+                from seaweedfs_tpu.stats.metrics import \
+                    ScrubCorruptionsFoundCounter
+                ScrubCorruptionsFoundCounter.labels("read").inc()
+                self._json({"error": str(e)}, code=500)
+                return
             except (NeedleError, EcShardNotFound) as e:
                 self._json({"error": str(e)}, code=404)
                 return
@@ -1078,6 +1139,7 @@ def _make_http_handler(vs: VolumeServer):
                 "Volumes": [Store.volume_info(v)
                             for loc in vs.store.locations
                             for v in loc.volumes.values()],
+                "Scrub": vs.scrub.status(),
             }
 
         def _redirect_to_replica(self, f) -> None:

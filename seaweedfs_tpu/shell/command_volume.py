@@ -465,6 +465,62 @@ def volume_server_leave(env: CommandEnv, argv: List[str], out) -> None:
     out.write(f"{args.node}: asked to leave\n")
 
 
+@command("volume.scrub", "start/pause/inspect the background integrity "
+                         "scrub")
+def volume_scrub(env: CommandEnv, argv: List[str], out) -> None:
+    """Control the per-server scrub daemon (seaweedfs_tpu/scrub/):
+    start a verification pass (the default), pause a running one, or
+    print each server's ledger. Without -node the action fans out to
+    every volume server in the topology."""
+    p = argparse.ArgumentParser(prog="volume.scrub")
+    p.add_argument("-node", default="",
+                   help="<host:port>; all volume servers when empty")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="restrict the pass to one volume id")
+    p.add_argument("-throttleMBps", type=float, default=0.0,
+                   help="IO budget for the pass (0 = server default)")
+    p.add_argument("-full", action="store_true",
+                   help="reset the ledger and rescan from scratch")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("-pause", action="store_true",
+                   help="hold the running pass at the next volume")
+    g.add_argument("-status", action="store_true",
+                   help="print the scrub ledger instead of starting")
+    args = p.parse_args(argv)
+    if args.node:
+        urls = [args.node]
+    else:
+        urls = sorted(dn.id for _, _, dn
+                      in env.data_nodes(env.topology()))
+    for url in urls:
+        stub = env.volume_server(url)
+        if args.status:
+            st = stub.VolumeScrubStatus(
+                volume_server_pb2.VolumeScrubStatusRequest())
+            out.write(
+                f"{url}: {st.state} passes:{st.passes_completed} "
+                f"scanned:{st.bytes_scanned}B "
+                f"needles:{st.needles_verified} "
+                f"stripes:{st.stripes_verified} "
+                f"found:{st.corruptions_found} "
+                f"repaired:{st.corruptions_repaired} "
+                f"unrecoverable:{st.unrecoverable} "
+                f"lag:{st.scan_lag_seconds:.0f}s\n")
+        elif args.pause:
+            r = stub.VolumeScrubPause(
+                volume_server_pb2.VolumeScrubPauseRequest())
+            out.write(f"{url}: "
+                      f"{'paused' if r.paused else 'no scrub running'}\n")
+        else:
+            r = stub.VolumeScrubStart(
+                volume_server_pb2.VolumeScrubStartRequest(
+                    volume_ids=[args.volumeId] if args.volumeId else [],
+                    throttle_mbps=args.throttleMBps,
+                    full=args.full))
+            out.write(f"{url}: "
+                      f"{'scrub started' if r.started else 'scrub already running'}\n")
+
+
 @command("volume.vacuum", "compact volumes above the garbage threshold")
 def volume_vacuum(env: CommandEnv, argv: List[str], out) -> None:
     p = argparse.ArgumentParser(prog="volume.vacuum")

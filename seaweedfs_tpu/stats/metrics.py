@@ -95,9 +95,10 @@ class Counter(_Metric):
         with self._lock:
             items = list(self._children.items())
         for values, child in items:
+            v = child.value() if callable(child.value) else child.value
             lines.append(f"{self.name}"
                          f"{_fmt_labels(self.label_names, values)}"
-                         f" {child.value}")
+                         f" {v}")
         return "\n".join(lines)
 
 
@@ -105,6 +106,14 @@ class _GaugeChild(_CounterChild):
     def set(self, v: float) -> None:
         with self._lock:
             self.value = v
+
+    def set_function(self, fn) -> None:
+        """Evaluate `fn()` at collection time instead of holding a
+        static value — for gauges like scan lag that must keep moving
+        between writes (a stalled producer would otherwise freeze the
+        exported value at its last set())."""
+        with self._lock:
+            self.value = fn
 
     def dec(self, amount: float = 1.0) -> None:
         self.inc(-amount)
@@ -118,6 +127,9 @@ class Gauge(Counter):
 
     def set(self, v: float) -> None:
         self.labels().set(v)
+
+    def set_function(self, fn) -> None:
+        self.labels().set_function(fn)
 
     def dec(self, amount: float = 1.0) -> None:
         self.labels().dec(amount)
@@ -258,6 +270,37 @@ FleetDispatchedBytesCounter = REGISTRY.counter(
 FleetWriterBacklogGauge = REGISTRY.gauge(
     "SeaweedFS_fleet_writer_lane_backlog",
     "writes queued on one writer lane", ("lane",))
+
+# Scrub families (seaweedfs_tpu/scrub/): the background integrity
+# subsystem's ledger. `kind` distinguishes what was damaged: a needle
+# in a normal volume ("needle"), an EC data shard ("ec_data"), an EC
+# parity shard ("ec_parity"), or a corruption surfaced by a client
+# read under SEAWEED_VERIFY_READS ("read").
+ScrubScannedBytesCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_scanned_bytes_total",
+    "bytes read and verified by the scrub scanner")
+ScrubNeedlesVerifiedCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_needles_verified_total",
+    "needle CRCs recomputed by the scrub scanner")
+ScrubStripesVerifiedCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_stripes_verified_total",
+    "EC stripe spans re-encoded and compared against stored parity")
+ScrubCorruptionsFoundCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_corruptions_found_total",
+    "silent corruptions detected", ("kind",))
+ScrubCorruptionsRepairedCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_corruptions_repaired_total",
+    "corruptions reconstructed back to byte-identical", ("kind",))
+ScrubUnrecoverableCounter = REGISTRY.counter(
+    "SeaweedFS_scrub_unrecoverable_total",
+    "corruptions beyond local repair (left quarantined)")
+ScrubPassSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_scrub_pass_seconds",
+    "wall time of one full scrub pass",
+    buckets=(0.01, 0.1, 1, 10, 60, 600, 3600, 6 * 3600, 24 * 3600))
+ScrubScanLagGauge = REGISTRY.gauge(
+    "SeaweedFS_scrub_scan_lag_seconds",
+    "seconds since the last completed scrub pass")
 
 
 # -- shared request instrumentation -------------------------------------------

@@ -77,6 +77,22 @@ class CookieMismatch(NeedleError):
     pass
 
 
+class DataCorruptionError(NeedleError):
+    """Stored bytes fail their checksum: silent corruption, not a
+    protocol error. Typed so read paths and the scrub subsystem can
+    route it to repair instead of treating it like a missing needle."""
+
+
+def verify_needle_integrity(n: "Needle") -> None:
+    """Raise DataCorruptionError unless n.data matches the stored
+    masked CRC. The one integrity predicate shared by the read path
+    (SEAWEED_VERIFY_READS) and the scrub scanner."""
+    if n.size > 0 and n.checksum != masked_crc(n.data):
+        raise DataCorruptionError(
+            f"needle {n.id:x} crc mismatch: stored {n.checksum:08x} "
+            f"!= computed {masked_crc(n.data):08x}")
+
+
 @dataclass
 class Needle:
     id: int = 0
@@ -168,10 +184,8 @@ class Needle:
         (n.checksum,) = struct.unpack_from(">I", blob, tail_off)
         if version == VERSION3:
             (n.append_at_ns,) = struct.unpack_from(">Q", blob, tail_off + 4)
-        if check_crc and size > 0 and n.checksum != masked_crc(n.data):
-            raise NeedleError(
-                f"needle {nid:x} crc mismatch: stored {n.checksum:08x} "
-                f"!= computed {masked_crc(n.data):08x}")
+        if check_crc:
+            verify_needle_integrity(n)
         return n
 
     def _parse_body(self, body: bytes) -> None:

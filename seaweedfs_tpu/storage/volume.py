@@ -33,6 +33,7 @@ from seaweedfs_tpu.storage.backend import (
 )
 from seaweedfs_tpu.storage.needle import (
     Needle, NeedleError, CookieMismatch, actual_size, VERSION3,
+    verify_needle_integrity,
 )
 from seaweedfs_tpu.storage.needle_map import NeedleMap, make_needle_map
 from seaweedfs_tpu.storage.superblock import SuperBlock, ReplicaPlacement, TTL
@@ -40,6 +41,25 @@ from seaweedfs_tpu.storage import idx as idx_codec
 
 
 _log = wlog.logger("storage.volume")
+
+# SEAWEED_VERIFY_READS=1: read_needle re-verifies the masked CRC of
+# every needle it returns through the shared integrity predicate and
+# raises the typed DataCorruptionError on mismatch. The record parse
+# already CRC-checks `data`; the strict gate additionally covers any
+# caller that parses with check_crc=False and keeps the corruption
+# surface typed (corrupt != missing). Resolved once at import — the
+# read path must not pay an environ lookup per needle; tests flip it
+# with set_verify_reads().
+_VERIFY_READS = os.environ.get("SEAWEED_VERIFY_READS", "") not in ("", "0")
+
+
+def set_verify_reads(on: bool) -> None:
+    global _VERIFY_READS
+    _VERIFY_READS = bool(on)
+
+
+def verify_reads_enabled() -> bool:
+    return _VERIFY_READS
 
 
 class VolumeError(Exception):
@@ -500,6 +520,8 @@ class Volume:
                 f"needle {n.id:x}: cookie {n.cookie:08x} != {got.cookie:08x}")
         if got.has_expired():
             raise NeedleError(f"needle {n.id:x} expired")
+        if _VERIFY_READS:
+            verify_needle_integrity(got)
         return got
 
     def _read_needle_at(self, offset: int, size: int,
@@ -546,7 +568,10 @@ class Volume:
                     is_marker = len(n.data) == 0
                     if include_deleted or not is_marker:
                         yield offset, n
-                except NeedleError:
+                except (NeedleError, struct.error, IndexError, ValueError):
+                    # a garbled record must not abort the scan: torn
+                    # size fields die in struct.unpack/_parse_body, not
+                    # just as clean NeedleErrors — skip it like one
                     pass
                 offset += length
 

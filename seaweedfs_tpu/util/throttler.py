@@ -1,4 +1,4 @@
-"""Bandwidth throttler for compaction / EC copy
+"""Bandwidth throttler for compaction / EC copy / scrub
 (reference: weed/util/throttler.go — -compactionMBps)."""
 
 from __future__ import annotations
@@ -8,21 +8,32 @@ import time
 
 class Throttler:
     """Call maybe_slowdown(n) after processing n bytes; sleeps so the
-    average rate stays at or below limit_mbps. 0 disables."""
+    average rate stays at or below limit_mbps. 0 disables.
 
-    def __init__(self, limit_mbps: float = 0.0):
+    Token bucket: credit accrues at the limit rate and is CAPPED at
+    burst_s seconds worth, so a long idle period cannot bank unlimited
+    budget — without the cap, a scrub that slept through a quiet hour
+    would then read at full disk speed for an hour straight, exactly
+    the IO spike the throttle exists to prevent. A call that overdraws
+    the bucket sleeps until the deficit is repaid.
+    """
+
+    def __init__(self, limit_mbps: float = 0.0, burst_s: float = 1.0):
         self.limit_bps = limit_mbps * 1024 * 1024
-        self._window_start = time.monotonic()
-        self._window_bytes = 0
+        self.burst_s = max(burst_s, 0.0)
+        self._credit = 0.0  # empty bucket: the first bytes pay full price
+        self._last = time.monotonic()
 
     def maybe_slowdown(self, n: int) -> None:
         if self.limit_bps <= 0:
             return
-        self._window_bytes += n
-        elapsed = time.monotonic() - self._window_start
-        expected = self._window_bytes / self.limit_bps
-        if expected > elapsed:
-            time.sleep(expected - elapsed)
-        if elapsed > 1.0:
-            self._window_start = time.monotonic()
-            self._window_bytes = 0
+        now = time.monotonic()
+        self._credit = min(self.limit_bps * self.burst_s,
+                           self._credit + (now - self._last) * self.limit_bps)
+        self._credit -= n
+        if self._credit < 0:
+            time.sleep(-self._credit / self.limit_bps)
+            self._credit = 0.0
+        # stamp AFTER any sleep: the sleep itself repaid the deficit and
+        # must not accrue as fresh credit on the next call
+        self._last = time.monotonic()

@@ -526,3 +526,118 @@ def test_snowflake_sequencer_master(tmp_path):
         if vs is not None:
             vs.stop()
         m.stop()
+
+
+def test_scrub_detects_and_repairs_corruption_end_to_end(tmp_path):
+    """The ISSUE 3 acceptance scenario: flip bytes in one EC shard and
+    one needle on disk, run a scrub pass, and assert the corruption is
+    detected, the shard is reconstructed byte-identical, the needle
+    read raises DataCorruptionError under SEAWEED_VERIFY_READS=1, and
+    SeaweedFS_scrub_corruptions_repaired_total increments."""
+    import urllib.request
+
+    from seaweedfs_tpu.ec.encoder import shard_file_name
+    from seaweedfs_tpu.shell import Shell
+    from seaweedfs_tpu.storage import volume as volume_mod
+    from seaweedfs_tpu.storage.needle import DataCorruptionError, Needle
+
+    c = Cluster(tmp_path, n_volume_servers=1)
+    vs = c.volume_servers[0]
+    stub = volume_stub(vs.url)
+
+    def repaired_total() -> float:
+        with c.http(f"{c.metrics_url}/metrics") as r:
+            text = r.read().decode()
+        return sum(
+            float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("SeaweedFS_scrub_corruptions_repaired_total")
+            and not line.startswith("#"))
+
+    try:
+        # an EC volume with known contents ...
+        datas = [os.urandom(1500) for _ in range(8)]
+        fids = [c.upload(d, collection="scr") for d, _ in
+                zip(datas, range(8))]
+        vid = parse_fid(fids[0]).volume_id
+        stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+        stub.VolumeEcShardsGenerate(
+            volume_server_pb2.VolumeEcShardsGenerateRequest(
+                volume_id=vid, collection="scr", encoder="numpy"))
+        stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection="scr",
+                shard_ids=list(range(14))))
+        stub.VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+        base = vs.store.find_ec_volume(vid).base_name
+        # ... plus a normal volume holding one needle we'll corrupt
+        nfid = c.upload(b"precious bytes " * 64)
+        nf = parse_fid(nfid)
+        nv = vs.store.find_volume(nf.volume_id)
+
+        # flip bytes: one EC data shard, one needle
+        shard_path = shard_file_name(base, 2)
+        with open(shard_path, "rb") as f:
+            pristine = f.read()
+        with open(shard_path, "r+b") as f:
+            f.seek(len(pristine) // 2)
+            byte = f.read(1)
+            f.seek(len(pristine) // 2)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        rec = nv.nm.get(nf.key)
+        with open(nv.dat_path, "r+b") as f:
+            off = rec.offset + 16 + 4 + 2  # header+dataSize+2 -> data
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0xFF]))
+
+        before = repaired_total()
+
+        # run a scrub pass through the ops plane
+        sh = Shell(c.master.url)
+        out = sh.run_command(f"volume.scrub -node {vs.url}")
+        assert "scrub started" in out
+
+        def pass_done():
+            st = stub.VolumeScrubStatus(
+                volume_server_pb2.VolumeScrubStatusRequest())
+            return st if st.passes_completed >= 1 else None
+        st = c.wait_for(pass_done, timeout=60, what="scrub pass")
+
+        # detected: the flipped needle + the flipped shard
+        assert st.corruptions_found >= 2, st
+        # the EC shard came back byte-identical, corpse quarantined
+        with open(shard_path, "rb") as f:
+            assert f.read() == pristine
+        assert os.path.exists(shard_path + ".corrupt")
+        assert st.corruptions_repaired >= 1, st
+        # the needle (replication 000: no replica) is unrecoverable
+        assert st.unrecoverable >= 1, st
+        assert repaired_total() - before >= 1
+
+        # EC payloads still read end to end after repair
+        for fid, d in zip(fids, datas):
+            with c.fetch(fid) as r:
+                assert r.read() == d
+
+        # the corrupt needle read raises the typed error under
+        # SEAWEED_VERIFY_READS=1 ...
+        volume_mod.set_verify_reads(True)
+        try:
+            with pytest.raises(DataCorruptionError):
+                nv.read_needle(Needle(id=nf.key, cookie=nf.cookie))
+        finally:
+            volume_mod.set_verify_reads(False)
+        # ... and over HTTP surfaces as 500 (corrupt != missing 404)
+        try:
+            c.fetch(nfid)
+            assert False, "corrupt read must not return bytes"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+        # status page carries the scrub ledger
+        with c.http(f"{vs.url}/status") as r:
+            assert json.load(r)["Scrub"]["corruptions_found"] >= 2
+    finally:
+        c.stop()

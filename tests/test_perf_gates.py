@@ -286,3 +286,57 @@ def test_pooled_client_reuses_connections(tmp_path):
     finally:
         socket.create_connection = orig
         c.stop()
+
+
+def test_scrub_disabled_overhead(tmp_path):
+    """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
+    test_tracing_disabled_overhead twin for the integrity subsystem).
+
+    Three gates. Construction: a ScrubDaemon attached to a store
+    spawns no thread and schedules no IO until start(). Read gate: the
+    SEAWEED_VERIFY_READS check is one module flag, off by default.
+    Engine: with an idle daemon attached the storage engine holds the
+    same write/read floors as the bare-engine microbench above — the
+    scrub subsystem adds NOTHING to the hot path (its only hook,
+    the typed DataCorruptionError raise, fires on corrupt bytes)."""
+    import threading
+
+    from seaweedfs_tpu.scrub import ScrubDaemon
+    from seaweedfs_tpu.storage import volume as volume_mod
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    def scrub_threads():
+        # named assertion, not an active_count() equality: unrelated
+        # threads from earlier tests in this process may EXIT while
+        # this test runs, which must not flake the gate
+        return [t.name for t in threading.enumerate()
+                if "scrub" in t.name.lower()]
+
+    assert not volume_mod.verify_reads_enabled()
+    store = Store([str(tmp_path)])
+    daemon = ScrubDaemon(store)   # attached but never started
+    assert scrub_threads() == [], \
+        "constructing the scrub daemon must not spawn threads"
+    assert daemon.status()["state"] == "idle"
+
+    store.add_volume(1)
+    v = store.find_volume(1)
+    blob = bytes(range(256)) * 4
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        v.write_needle(Needle(id=i, cookie=9, data=blob))
+    write_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        v.read_needle(Needle(id=i, cookie=9))
+    read_us = (time.perf_counter() - t0) / n * 1e6
+    store.close()
+    assert scrub_threads() == []
+    # identical floors to test_storage_engine_microbench: an idle
+    # scrub daemon buys zero hot-path regression budget
+    assert write_us <= 500, f"engine write {write_us:.0f} us/needle " \
+        f"with idle scrub daemon attached"
+    assert read_us <= 250, f"engine read {read_us:.0f} us/needle " \
+        f"with idle scrub daemon attached"

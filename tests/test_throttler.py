@@ -1,0 +1,60 @@
+"""util.throttler contract: rate convergence, the burst cap, and the
+disabled (zero-limit) fast path. The compaction, EC-copy and scrub
+paths all pace their IO through this one class, so its failure mode is
+a cluster-wide IO spike, not a unit nicety."""
+
+import time
+
+from seaweedfs_tpu.util.throttler import Throttler
+
+
+def test_rate_converges_to_limit():
+    # 20 MB/s limit, 10 MB pushed in 256KB slices -> ~0.5s wall.
+    th = Throttler(limit_mbps=20)
+    total = 10 << 20
+    step = 256 << 10
+    t0 = time.monotonic()
+    sent = 0
+    while sent < total:
+        th.maybe_slowdown(step)
+        sent += step
+    elapsed = time.monotonic() - t0
+    ideal = total / (20 * 1024 * 1024)
+    # lower bound: never materially faster than the limit (minus the
+    # one-burst allowance); upper bound generous for CI scheduling
+    assert elapsed >= ideal * 0.7, \
+        f"ran at {total / elapsed / 1e6:.1f} MB/s against a 21 MB/s cap"
+    assert elapsed < ideal * 5
+
+
+def test_burst_cap_bounds_idle_credit():
+    # After a long idle period, at most burst_s seconds of budget may
+    # be banked: a 3 MB burst at 10 MB/s with burst_s=0.1 gets 1 MB
+    # free and must sleep ~0.2s for the rest.
+    th = Throttler(limit_mbps=10, burst_s=0.1)
+    th.maybe_slowdown(1)          # start the clock
+    time.sleep(0.5)               # idle: would bank 5 MB uncapped
+    t0 = time.monotonic()
+    th.maybe_slowdown(3 << 20)
+    elapsed = time.monotonic() - t0
+    assert elapsed >= 0.12, \
+        f"idle credit not capped: 3MB burst took only {elapsed:.3f}s"
+
+
+def test_burst_allowance_is_granted():
+    # Within the cap, banked credit IS spendable: after idling past
+    # burst_s, a burst no larger than the bucket passes without sleep.
+    th = Throttler(limit_mbps=10, burst_s=0.3)
+    th.maybe_slowdown(1)
+    time.sleep(0.4)               # bank the full 3 MB bucket
+    t0 = time.monotonic()
+    th.maybe_slowdown(2 << 20)    # 2 MB < 3 MB banked
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_zero_limit_disabled_is_free():
+    th = Throttler(0)
+    t0 = time.monotonic()
+    for _ in range(1000):
+        th.maybe_slowdown(1 << 30)
+    assert time.monotonic() - t0 < 0.05

@@ -167,3 +167,86 @@ def test_commit_preserves_replication_changed_mid_compaction(vol):
         assert str(v2.replica_placement) == "010"
     finally:
         v2.close()
+
+
+def test_recover_interrupted_compact_cpd_only(tmp_path):
+    """Crash DURING the compact scan: the .cpd exists but the .cpx was
+    never written. recover_compaction must abort (drop the partial
+    .cpd) and the original volume must be fully intact."""
+    import os
+    from seaweedfs_tpu.storage.vacuum import recover_compaction
+    v = Volume(str(tmp_path), "", 21)
+    needles = [make_needle(i) for i in range(4)]
+    for n in needles:
+        v.write_needle(n)
+    state = compact(v)
+    os.remove(state.cpx_path)  # simulate dying before the .cpx write
+    v.close()
+    recover_compaction(str(tmp_path / "21"))
+    assert not (tmp_path / "21.cpd").exists()
+    v2 = Volume(str(tmp_path), "", 21, create_if_missing=False)
+    assert v2.file_count == 4
+    for n in needles:
+        assert v2.read_needle(Needle(id=n.id, cookie=n.cookie)).data == n.data
+    v2.close()
+
+
+def test_recover_compaction_is_idempotent_noop(tmp_path):
+    """No shadow files: recover_compaction must be a no-op, and
+    calling it repeatedly (every load does) must stay one."""
+    from seaweedfs_tpu.storage.vacuum import recover_compaction
+    v = Volume(str(tmp_path), "", 22)
+    v.write_needle(make_needle(0))
+    v.close()
+    before = sorted(p.name for p in tmp_path.iterdir())
+    recover_compaction(str(tmp_path / "22"))
+    recover_compaction(str(tmp_path / "22"))
+    assert sorted(p.name for p in tmp_path.iterdir()) == before
+    v2 = Volume(str(tmp_path), "", 22, create_if_missing=False)
+    assert v2.file_count == 1
+    v2.close()
+
+
+def test_interrupted_commit_keeps_acked_mid_compaction_writes(tmp_path):
+    """Writes acked AFTER the compact scan but BEFORE the (crashed)
+    commit ride the original .dat; the abort path must keep them."""
+    v = Volume(str(tmp_path), "", 23)
+    old = [make_needle(i) for i in range(3)]
+    for n in old:
+        v.write_needle(n)
+    compact(v)  # shadows left behind; commit never runs
+    late = [make_needle(i, size=64) for i in range(10, 14)]
+    for n in late:
+        v.write_needle(n)  # acked post-scan
+    v.close()  # "crash": shadows still on disk
+    v2 = Volume(str(tmp_path), "", 23, create_if_missing=False)
+    assert not (tmp_path / "23.cpd").exists()
+    assert not (tmp_path / "23.cpx").exists()
+    assert v2.file_count == 7
+    for n in old + late:
+        assert v2.read_needle(Needle(id=n.id, cookie=n.cookie)).data == n.data
+    v2.close()
+
+
+def test_roll_forward_then_reload_serves_post_swap_state(tmp_path):
+    """After the roll-forward recovery (interrupted commit between the
+    two renames), a SECOND reload must see a stable, shadow-free
+    volume — recovery must not leave state that re-triggers itself."""
+    import os
+    v = Volume(str(tmp_path), "", 24)
+    needles = [make_needle(i) for i in range(6)]
+    for n in needles:
+        v.write_needle(n)
+    for n in needles[:2]:
+        v.delete_needle(Needle(id=n.id, cookie=n.cookie))
+    state = compact(v)
+    v.close()
+    os.replace(state.cpd_path, str(tmp_path / "24.dat"))  # first rename only
+    v2 = Volume(str(tmp_path), "", 24, create_if_missing=False)
+    v2.close()
+    v3 = Volume(str(tmp_path), "", 24, create_if_missing=False)
+    assert v3.file_count == 4
+    assert v3.garbage_ratio() == 0.0
+    for n in needles[2:]:
+        assert v3.read_needle(Needle(id=n.id, cookie=n.cookie)).data == n.data
+    v3.close()
