@@ -344,7 +344,138 @@ def scrub_verify_sweep(batches=(1, 8)) -> dict:
             "volume_mb": vol_mb, "backend": backend, "sweep": sweep}
 
 
+def degraded_read_sweep(batches=(1, 8, 64)) -> dict:
+    """--degraded mode: degraded-read serving throughput.
+
+    One EC volume loses 2 data shards; B concurrent readers hammer
+    needles whose intervals cross the lost shards. Three paths per B:
+
+      per_interval  the in-place fallback — every reader fetches its
+                    own 10 source rows and solves its own one-row
+                    reconstruction (the pre-ISSUE-4 shape);
+      fused         the DegradedReadFleet — concurrent requests fuse
+                    into [B, 10, span] decode dispatches;
+      cached        a second pass over the same keys with the tiered
+                    read cache warm — hit rate and the throughput a
+                    hot degraded range actually serves at.
+
+    Reported as needle reads/s (best-of-N, paths alternated per the
+    fleet-sweep methodology — single-shot timings on shared VMs swing
+    ±50%).
+    """
+    import tempfile
+    import threading
+
+    from seaweedfs_tpu import ec as ec_mod
+    from seaweedfs_tpu.cache import TieredReadCache
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+    from seaweedfs_tpu.reads import DegradedReadFleet
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    backend = os.environ.get("BENCH_FLEET_BACKEND") or _cpu_backend()
+    n_needles = int(os.environ.get("BENCH_DEGRADED_NEEDLES", "256"))
+    needle_kb = int(os.environ.get("BENCH_DEGRADED_NEEDLE_KB", "64"))
+    repeats = int(os.environ.get("BENCH_DEGRADED_REPEATS", "3"))
+    lost = (0, 3)
+    rng = np.random.default_rng(13)
+    sweep = []
+    with tempfile.TemporaryDirectory() as d:
+        v = Volume(d, "", 1)
+        payload_bytes = 0
+        for i in range(1, n_needles + 1):
+            data = rng.integers(0, 256, needle_kb << 10,
+                                dtype=np.uint8).tobytes()
+            v.write_needle(Needle(id=i, cookie=0xB0, data=data))
+            payload_bytes += len(data)
+        v.close()
+        base = os.path.join(d, "1")
+        ec_mod.write_ec_files(base, backend=backend)
+        ec_mod.write_sorted_file_from_idx(base)
+        ecv = EcVolume(d, "", 1)
+        for i in range(14):
+            if i not in lost:
+                ecv.mount_shard(i)
+
+        def run_readers(b, keys, decoder=None, cache=None):
+            """b threads split `keys`; returns wall seconds."""
+            errs = []
+            chunks = [keys[i::b] for i in range(b)]
+
+            def worker(mine):
+                try:
+                    for k in mine:
+                        if cache is not None:
+                            from seaweedfs_tpu.ec import store_ec
+
+                            class _S:
+                                def find_ec_volume(self, vid):
+                                    return ecv
+                            store_ec.read_ec_needle(
+                                _S(), 1, Needle(id=k, cookie=0xB0),
+                                cache=cache, decoder=decoder)
+                        else:
+                            ecv.read_needle(Needle(id=k, cookie=0xB0),
+                                            decoder=decoder)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(ch,))
+                  for ch in chunks if ch]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise errs[0]
+            return time.perf_counter() - t0
+
+        keys = list(range(1, n_needles + 1))
+        for b in batches:
+            serial_s, fused_s = [], []
+            fleet = DegradedReadFleet(backend=backend,
+                                      batch_window_s=0.004)
+            for _ in range(max(1, repeats)):
+                serial_s.append(run_readers(b, keys))
+                fused_s.append(run_readers(b, keys, decoder=fleet))
+            occupancy = fleet.spans_decoded / max(1, fleet.dispatches)
+            # cache pass: cold fill, then hot re-read (hit rate is the
+            # HOT pass's — the steady state a hot degraded range sees)
+            cache = TieredReadCache(1 << 30)
+            run_readers(b, keys, decoder=fleet, cache=cache)
+            h0, m0 = cache.hits, cache.misses
+            hot_s = run_readers(b, keys, decoder=fleet, cache=cache)
+            dh, dm = cache.hits - h0, cache.misses - m0
+            hit_rate = dh / max(1, dh + dm)
+            fleet.stop()
+            sweep.append({
+                "concurrency": b,
+                "per_interval_reads_s":
+                    round(len(keys) / min(serial_s), 1),
+                "fused_reads_s": round(len(keys) / min(fused_s), 1),
+                "speedup": round(min(serial_s) / min(fused_s), 3),
+                "fused_batch_occupancy": round(occupancy, 2),
+                "cached_reads_s": round(len(keys) / hot_s, 1),
+                "cache_hit_rate": round(hit_rate, 4),
+            })
+        ecv.close()
+    return {"metric": "degraded_read_sweep", "unit": "reads/s",
+            "needles": n_needles, "needle_kb": needle_kb,
+            "lost_shards": list(lost), "backend": backend,
+            "sweep": sweep}
+
+
 def main() -> None:
+    if "--degraded" in sys.argv:
+        # degraded mode is host-pipeline only: serving-path decode
+        # throughput, not the kernel headline
+        line = degraded_read_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_DEGRADED.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
     if "--scrub" in sys.argv:
         # scrub mode is host-pipeline only: verify throughput of the
         # integrity scanner, not the kernel headline

@@ -163,6 +163,25 @@ def _volume_parser() -> argparse.ArgumentParser:
                         "via volume.scrub / the master scheduler)")
     p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
                    choices=["auto", "jax", "native", "numpy", "pallas"])
+    p.add_argument("-cache.sizeMB", dest="cache_size_mb", type=int,
+                   default=0,
+                   help="RAM budget for the tiered read cache "
+                        "(0 = disabled; serves hot EC/needle reads and "
+                        "reconstructed spans)")
+    p.add_argument("-cache.dir", dest="cache_dir", default="",
+                   help="directory for the read cache's disk tier "
+                        "(empty = RAM tier only)")
+    p.add_argument("-degraded.fleet", dest="degraded_fleet",
+                   type=lambda s: s.lower() not in ("0", "false", "no"),
+                   default=True,
+                   help="fuse concurrent degraded-read reconstructions "
+                        "into batched RS decode dispatches (false = "
+                        "per-interval in-place recovery)")
+    p.add_argument("-degraded.batchMs", dest="degraded_batch_ms",
+                   type=float, default=2.0,
+                   help="decode-fleet batch window in milliseconds: how "
+                        "long a reconstruction waits to fuse with "
+                        "concurrent ones")
     p.add_argument("-index", dest="needle_map_kind", default="memory",
                    choices=["memory", "kv"],
                    help="needle map kind: memory (dict rebuild from .idx) "
@@ -206,7 +225,11 @@ def _build_volume(opts):
         storage_backends=_storage_backend_conf(),
         needle_map_kind=opts.needle_map_kind,
         scrub_mbps=opts.scrub_mbps,
-        scrub_interval_s=opts.scrub_interval_s)
+        scrub_interval_s=opts.scrub_interval_s,
+        cache_size_mb=opts.cache_size_mb,
+        cache_dir=opts.cache_dir or None,
+        degraded_fleet=opts.degraded_fleet,
+        degraded_batch_ms=opts.degraded_batch_ms)
 
 
 @command("volume", "start a volume server (data plane)")

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -24,13 +25,35 @@ from seaweedfs_tpu.ec.encoder import (
 )
 from seaweedfs_tpu.ec.shard_bits import ShardBits, DATA_SHARDS, TOTAL_SHARDS
 from seaweedfs_tpu.ops.rs_code import ReedSolomon
+from seaweedfs_tpu.stats.metrics import (
+    ReadsDecodedBytesCounter, ReadsDegradedCounter, ReadsShortShardCounter)
 from seaweedfs_tpu.storage import idx as idx_codec
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle import Needle, NeedleError, actual_size
+from seaweedfs_tpu.util import wlog
+
+log = wlog.logger("ec")
 
 
 class EcShardNotFound(NeedleError):
     pass
+
+
+# Shared fetch pool for the in-place (non-fleet) recovery fallback:
+# created lazily on the FIRST degraded read, so a healthy server never
+# spawns these threads (the degraded-decode-disabled perf gate).
+_recover_pool: Optional[ThreadPoolExecutor] = None
+_recover_pool_lock = threading.Lock()
+
+
+def _get_recover_pool() -> ThreadPoolExecutor:
+    global _recover_pool
+    if _recover_pool is None:
+        with _recover_pool_lock:
+            if _recover_pool is None:
+                _recover_pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="ec-recover")
+    return _recover_pool
 
 
 class EcVolumeShard:
@@ -77,6 +100,10 @@ class EcVolume:
         self._ecj = open(self.base_name + ".ecj", "a+b")
         self._lock = threading.RLock()
         self.shards: Dict[int, EcVolumeShard] = {}
+        # shards whose short local read was already logged (once per
+        # shard, so recovery traffic is distinguishable from decay
+        # without flooding the log on a hot truncated shard)
+        self._short_logged: set = set()
         # remote shard location cache: shard id -> list of server urls
         self.shard_locations: Dict[int, List[str]] = {}
         self.shard_locations_refreshed_at = 0.0
@@ -165,18 +192,19 @@ class EcVolume:
 
     def read_needle(self, n: Needle, version: int = 3,
                     remote_reader: Optional[Callable] = None,
-                    rs: Optional[ReedSolomon] = None) -> Needle:
+                    rs: Optional[ReedSolomon] = None,
+                    decoder=None, span_cache=None) -> Needle:
         """Read+verify a needle from local shards, remote shards, or by
         live RS reconstruction of missing intervals.
 
         remote_reader(shard_id, shard_offset, length) -> bytes|None is
-        supplied by the volume server for non-local shards.
+        supplied by the volume server for non-local shards. `decoder`
+        (reads.DegradedReadFleet) routes reconstructions to the fused
+        batch path; `span_cache` (cache.TieredReadCache) serves repeat
+        degraded reads without re-solving.
         """
-        _, size, intervals = self.locate_needle(n.id, version)
-        pieces = []
-        for iv in intervals:
-            pieces.append(self._read_interval(iv, remote_reader, rs))
-        blob = b"".join(pieces)
+        blob = self.read_needle_blob(n.id, version, remote_reader, rs,
+                                     decoder, span_cache)
         got = Needle.from_bytes(blob, version)
         if n.cookie and got.cookie != n.cookie:
             from seaweedfs_tpu.storage.needle import CookieMismatch
@@ -184,57 +212,160 @@ class EcVolume:
                 f"needle {n.id:x}: cookie {n.cookie:08x} != {got.cookie:08x}")
         return got
 
+    def read_needle_blob(self, needle_id: int, version: int = 3,
+                         remote_reader: Optional[Callable] = None,
+                         rs: Optional[ReedSolomon] = None,
+                         decoder=None, span_cache=None) -> bytes:
+        """The raw stored record bytes of one needle — the unit the
+        tiered read cache stores (Needle.from_bytes CRC-checks it on
+        every parse, so a torn cache entry can never serve)."""
+        _, size, intervals = self.locate_needle(needle_id, version)
+        pieces = []
+        for iv in intervals:
+            pieces.append(self._read_interval(iv, remote_reader, rs,
+                                              decoder, span_cache))
+        return b"".join(pieces)
+
     def _read_interval(self, iv: ec_locate.Interval,
                        remote_reader: Optional[Callable],
-                       rs: Optional[ReedSolomon]) -> bytes:
+                       rs: Optional[ReedSolomon],
+                       decoder=None, span_cache=None) -> bytes:
         shard_id, off = iv.to_shard_and_offset(self.large_block, self.small_block)
         s = self.shards.get(shard_id)
         if s is not None:
-            data = s.read_at(off, iv.size)
+            err = None
+            try:
+                data = s.read_at(off, iv.size)
+            except (OSError, ValueError) as e:
+                # failing disk, or the shard closed by a concurrent
+                # unmount: same demotion as a short read — reconstruct
+                err, data = e, b""
             if len(data) == iv.size:
                 return data
-            # short read (e.g. shard truncated by a crashed rebuild):
-            # treat the shard as missing and reconstruct from the others
+            # short read (e.g. shard truncated by a crashed rebuild)
+            # or read error: treat the shard as missing and reconstruct
+            # from the others — but COUNT it, and log once per shard,
+            # so operators can tell silent-recovery traffic from decay.
+            # The log distinguishes truncation from IO errors: they
+            # point at different repairs (bad rebuild vs dying disk).
+            ReadsShortShardCounter.labels(
+                str(self.volume_id), str(shard_id)).inc()
+            if shard_id not in self._short_logged:
+                self._short_logged.add(shard_id)
+                if err is not None:
+                    log.warning(
+                        "ec volume %d shard %d: local read error at %d "
+                        "(%s); serving via reconstruction until repaired",
+                        self.volume_id, shard_id, off, err)
+                else:
+                    log.warning(
+                        "ec volume %d shard %d: short local read (%d < "
+                        "%d at %d); serving via reconstruction until "
+                        "repaired",
+                        self.volume_id, shard_id, len(data), iv.size, off)
             return self._recover_interval(shard_id, off, iv.size,
-                                          remote_reader, rs)
+                                          remote_reader, rs, decoder,
+                                          span_cache)
         if remote_reader is not None:
-            data = remote_reader(shard_id, off, iv.size)
-            if data is not None:
+            try:
+                data = remote_reader(shard_id, off, iv.size)
+            except Exception:  # a dead peer demotes to reconstruction
+                data = None
+            if data is not None and len(data) == iv.size:
                 return data
-        return self._recover_interval(shard_id, off, iv.size, remote_reader, rs)
+        return self._recover_interval(shard_id, off, iv.size, remote_reader,
+                                      rs, decoder, span_cache)
 
     def _recover_interval(self, missing_shard: int, off: int, length: int,
                           remote_reader: Optional[Callable],
-                          rs: Optional[ReedSolomon]) -> bytes:
+                          rs: Optional[ReedSolomon],
+                          decoder=None, span_cache=None) -> bytes:
         """On-the-fly RS reconstruction of one interval
-        (reference store_ec.go:322-376)."""
+        (reference store_ec.go:322-376).
+
+        A reconstructed span is served from / published to `span_cache`
+        when one is wired, and the solve itself goes to the fused
+        `decoder` fleet when enabled, else to the in-place parallel
+        fetch + single-row solve fallback."""
+        gen = None
+        if span_cache is not None:
+            key = span_cache.span_key(self.volume_id, missing_shard, off,
+                                      length)
+            hit = span_cache.get(key)
+            if hit is not None:
+                if len(hit) == length:
+                    return hit
+                # torn span file (disk-tier entry truncated by power
+                # loss): drop it and reconstruct
+                span_cache.drop(key)
+            # snapshot before solving: a rebuild/scrub invalidation
+            # racing this reconstruction must win (set refuses stale)
+            gen = span_cache.generation(key)
+        if decoder is not None:
+            data = decoder.decode(self, missing_shard, off, length,
+                                  remote_reader)
+        else:
+            data = self._recover_in_place(missing_shard, off, length,
+                                          remote_reader, rs)
+        if span_cache is not None:
+            span_cache.set(key, data, gen=gen)
+        return data
+
+    def _recover_in_place(self, missing_shard: int, off: int, length: int,
+                          remote_reader: Optional[Callable],
+                          rs: Optional[ReedSolomon]) -> bytes:
+        """The fleet-less fallback: fetch 10 source rows with the
+        shared reader pool (local reads all in parallel, then the
+        remote deficit in parallel) and solve the one-row
+        reconstruction locally. Byte-identical to the historical
+        serial loop — any 10 valid rows produce the same bytes."""
         rs = rs or ReedSolomon()
-        rows = []
-        ids = []
-        for sid in range(TOTAL_SHARDS):
-            if sid == missing_shard:
-                continue
-            buf = None
-            s = self.shards.get(sid)
-            if s is not None:
-                b = s.read_at(off, length)
-                if len(b) == length:
-                    buf = np.frombuffer(b, dtype=np.uint8)
-            if buf is None and remote_reader is not None:
-                b = remote_reader(sid, off, length)
-                if b is not None and len(b) == length:
-                    buf = np.frombuffer(b, dtype=np.uint8)
-            if buf is not None:
+        pool = _get_recover_pool()
+        rows: List[np.ndarray] = []
+        ids: List[int] = []
+        # snapshot: a concurrent unmount between membership test and
+        # element access must degrade the row, not raise KeyError
+        shards = dict(self.shards)
+        local_futs = [
+            (sid, pool.submit(shards[sid].read_at, off, length))
+            for sid in range(TOTAL_SHARDS)
+            if sid != missing_shard and sid in shards]
+        for sid, fut in local_futs:
+            try:
+                b = fut.result()
+            except (OSError, ValueError):  # failing disk / closed by
+                b = b""                    # a concurrent unmount
+            if len(b) == length and len(ids) < DATA_SHARDS:
                 ids.append(sid)
-                rows.append(buf)
-            if len(ids) >= DATA_SHARDS:
-                break
+                rows.append(np.frombuffer(b, dtype=np.uint8))
+        if len(ids) < DATA_SHARDS and remote_reader is not None:
+            remote_sids = [sid for sid in range(TOTAL_SHARDS)
+                           if sid != missing_shard and sid not in ids]
+            remote_futs = [(sid, pool.submit(remote_reader, sid, off,
+                                             length))
+                           for sid in remote_sids]
+            for sid, fut in remote_futs:
+                if len(ids) >= DATA_SHARDS:
+                    break
+                try:
+                    b = fut.result()
+                except Exception:  # a dead peer fails rows, not reads
+                    b = None
+                if b is not None and len(b) == length:
+                    ids.append(sid)
+                    rows.append(np.frombuffer(b, dtype=np.uint8))
         if len(ids) < DATA_SHARDS:
             raise EcShardNotFound(
                 f"vid {self.volume_id} shard {missing_shard}: only "
                 f"{len(ids)} shards reachable, need {DATA_SHARDS}")
-        src = np.stack(rows, axis=0)
+        # rows were appended local-first: restore canonical sid order so
+        # the decode matrix (and its cache key) is deterministic
+        order = np.argsort(ids)
+        src = np.stack([rows[i] for i in order], axis=0)
+        ids = [ids[i] for i in order]
         out = rs.reconstruct_some(ids, [missing_shard], src)
+        ReadsDegradedCounter.inc()
+        ReadsDecodedBytesCounter.inc(float(length))
         return out[0].tobytes()
 
     # -- lifecycle -----------------------------------------------------------

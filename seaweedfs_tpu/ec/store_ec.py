@@ -11,6 +11,7 @@ stays EC-agnostic (the ec package plugs into DiskLocation.ec_volumes).
 from __future__ import annotations
 
 import os
+import struct
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 from seaweedfs_tpu.ec import encoder, fleet
@@ -185,21 +186,77 @@ def read_ec_shard(store: Store, vid: int, shard_id: int, offset: int,
 
 def read_ec_needle(store: Store, vid: int, n: Needle,
                    remote_reader: Optional[Callable] = None,
-                   rs: Optional[ReedSolomon] = None) -> Needle:
+                   rs: Optional[ReedSolomon] = None,
+                   cache=None, decoder=None,
+                   version: int = 3) -> Needle:
     """ReadEcShardNeedle: cookie-checked needle read over shards, with
-    remote fan-out and on-the-fly RS recovery (store_ec.go:122-262)."""
+    remote fan-out and on-the-fly RS recovery (store_ec.go:122-262).
+
+    With a `cache` (cache.TieredReadCache) the whole stored record
+    rides the needle-keyed tier: repeat reads of a hot needle — healthy
+    or degraded — cost one cache hit and a CRC-checked parse, and
+    concurrent misses single-flight so one reconstruction serves them
+    all. `decoder` (reads.DegradedReadFleet) fuses any reconstruction
+    the read does need into batched RS dispatches.
+    """
     ecv = store.find_ec_volume(vid)
     if ecv is None:
         raise EcShardNotFound(f"ec volume {vid} not mounted")
-    return ecv.read_needle(n, remote_reader=remote_reader, rs=rs)
+    if cache is None:
+        return ecv.read_needle(n, version, remote_reader=remote_reader,
+                               rs=rs, decoder=decoder)
+    sp = trace.span("reads.ec_needle", vid=vid) \
+        if trace.is_enabled() else trace.NOOP
+    with sp:
+        key = cache.needle_key(vid, n.id)
+        blob = cache.get(key)
+        if blob is None:
+            with cache.single_flight(key) as leader:
+                if not leader:
+                    blob = cache.get(key)  # the leader's result
+                if blob is None:
+                    # gen snapshot BEFORE the read: if the key or its
+                    # volume is invalidated while we reconstruct
+                    # (delete, scrub repair), set() refuses the blob
+                    gen = cache.generation(key)
+                    blob = ecv.read_needle_blob(
+                        n.id, version, remote_reader, rs, decoder,
+                        span_cache=cache)
+                    cache.set(key, blob, gen=gen)
+        try:
+            got = Needle.from_bytes(blob, version)
+        except (NeedleError, ValueError, IndexError, struct.error):
+            # poisoned cache data (a file torn by power loss before
+            # restart): a bad NEEDLE entry arrives as a cache hit; a
+            # bad SPAN entry poisons a freshly-assembled blob. Either
+            # way: drop the needle key AND the volume's span entries,
+            # then retry once straight from the shards (span cache
+            # bypassed). A retry failure is true shard corruption and
+            # propagates.
+            cache.drop(key)
+            cache.drop_spans(vid)
+            gen = cache.generation(key)
+            blob = ecv.read_needle_blob(n.id, version, remote_reader,
+                                        rs, decoder, span_cache=None)
+            cache.set(key, blob, gen=gen)
+            got = Needle.from_bytes(blob, version)
+    if n.cookie and got.cookie != n.cookie:
+        from seaweedfs_tpu.storage.needle import CookieMismatch
+        raise CookieMismatch(
+            f"needle {n.id:x}: cookie {n.cookie:08x} != {got.cookie:08x}")
+    return got
 
 
-def delete_ec_needle(store: Store, vid: int, n: Needle) -> None:
-    """Tombstone in .ecx + journal to .ecj (store_ec_delete.go)."""
+def delete_ec_needle(store: Store, vid: int, n: Needle,
+                     cache=None) -> None:
+    """Tombstone in .ecx + journal to .ecj (store_ec_delete.go);
+    drops the needle's cached entries so a delete is never masked."""
     ecv = store.find_ec_volume(vid)
     if ecv is None:
         raise EcShardNotFound(f"ec volume {vid} not mounted")
     ecv.delete_needle(n.id)
+    if cache is not None:
+        cache.invalidate(vid, n.id, reason="delete")
 
 
 def scrub_ec_volume(store: Store, vid: int, backend: str = "auto",

@@ -1,0 +1,8 @@
+"""Read-serving subsystem: the degraded-read decode fleet.
+
+Fuses concurrent on-the-fly RS reconstructions from the serving path
+into batched `[B, 10, span]` decode dispatches — the read-side twin of
+the `ec/fleet.py` encode/verify/rebuild schedulers.
+"""
+
+from seaweedfs_tpu.reads.decode_fleet import DegradedReadFleet  # noqa: F401

@@ -1,0 +1,408 @@
+"""Degraded-read decode fleet: fused RS reconstruction for serving.
+
+`EcVolume._recover_interval` solves a one-row RS reconstruction per
+request — under concurrent degraded traffic (a dead shard behind a hot
+key range) every HTTP/gRPC handler thread pays its own shard fetches
+and its own tiny decode dispatch. This fleet lifts the batch dimension
+to requests-ACROSS-handlers, the same move `ec/fleet.py` made for
+encode/verify/rebuild:
+
+  queue     handler threads enqueue reconstruction requests and block
+            on a per-request event; a single dispatcher thread owns
+            batching, so admission costs one queue put.
+  window    the dispatcher takes the first request immediately and
+            drains the queue for at most `batch_window_s` more (a few
+            ms) — a lone request never waits longer than the window,
+            and under load the window fills toward `max_batch`.
+  fetch     source rows (10 per request: local shard reads + remote
+            shard fetches) run on a shared reader pool, overlapped
+            ACROSS the whole batch — the slow part of a degraded read
+            is fetching 10x the bytes, and serial fetch is exactly
+            what the satellite fallback path does without the fleet.
+  solve     requests sharing a (present, missing) signature share one
+            decode matrix, so their spans pad to a common width and
+            stack into ONE `[B, 10, span]` reconstruct dispatch on the
+            same ReedSolomon backend the encode fleet uses.
+  latch     errors stay per-request: an unreachable volume (fewer than
+            10 rows) fails only its own request's event; the rest of
+            the batch decodes normally.
+
+Zero-cost-disabled contract: constructing the fleet spawns NOTHING —
+no thread, no pool — until the first decode() call (gated by
+tests/test_perf_gates.py::test_degraded_decode_disabled_overhead).
+When the fleet is disabled entirely the EC read path falls back to
+`EcVolume._recover_interval`'s parallel in-place recovery.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, TOTAL_SHARDS, ReedSolomon
+from seaweedfs_tpu.stats import trace
+from seaweedfs_tpu.stats.metrics import (
+    ReadsDecodedBytesCounter, ReadsDegradedBatchHistogram,
+    ReadsDegradedCounter)
+from seaweedfs_tpu.util import wlog
+
+log = wlog.logger("reads")
+
+# How long the dispatcher keeps the window open after the first request
+# of a batch: long enough to fuse a concurrent burst, short enough to
+# be invisible next to the shard fetches a degraded read already pays.
+BATCH_WINDOW_S = 0.002
+
+# Fused spans per decode dispatch (the [B, 10, span] B bound).
+MAX_BATCH = 64
+
+# Reader-pool width for source-row fetches, shared by the whole batch.
+FLEET_READERS = 8
+
+
+# Ceiling on waiting for one source-row fetch future: local reads are
+# instant and remote reads carry their own gRPC deadline, so anything
+# past this is a wedged peer — fail the ROW, keep the batch moving.
+FETCH_TIMEOUT_S = 30.0
+
+
+class _Request:
+    __slots__ = ("ecv", "missing", "offset", "length", "remote_reader",
+                 "rows", "ids", "result", "error", "done", "_local_futs",
+                 "_remote_futs", "_candidates")
+
+    def __init__(self, ecv, missing: int, offset: int, length: int,
+                 remote_reader: Optional[Callable]):
+        self.ecv = ecv
+        self.missing = missing
+        self.offset = offset
+        self.length = length
+        self.remote_reader = remote_reader
+        self.rows: List[np.ndarray] = []
+        self.ids: List[int] = []
+        self.result: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+
+
+def _read_local(shard, offset: int, length: int) -> Optional[bytes]:
+    try:
+        b = shard.read_at(offset, length)
+    except OSError:
+        return None
+    return b if len(b) == length else None
+
+
+def _read_remote(remote_reader, sid: int, offset: int,
+                 length: int) -> Optional[bytes]:
+    try:
+        b = remote_reader(sid, offset, length)
+    except Exception:  # remote fetch must never poison the batch
+        return None
+    return b if b is not None and len(b) == length else None
+
+
+def _await_row(fut) -> Optional[bytes]:
+    """One fetch future's row, or None if it failed or wedged — a
+    stuck row costs its request a source shard, never the dispatcher."""
+    try:
+        return fut.result(timeout=FETCH_TIMEOUT_S)
+    except Exception:
+        return None
+
+
+class DegradedReadFleet:
+    """Fuses concurrent degraded-read reconstructions into batched RS
+    decode dispatches. Thread-safe; threads spawn lazily on first use."""
+
+    def __init__(self, backend: str = "auto",
+                 batch_window_s: float = BATCH_WINDOW_S,
+                 max_batch: int = MAX_BATCH,
+                 readers: int = FLEET_READERS):
+        self.backend = backend
+        self.batch_window_s = batch_window_s
+        self.max_batch = max(1, max_batch)
+        self.readers = max(1, readers)
+        self._rs: Optional[ReedSolomon] = None
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._start_lock = threading.Lock()
+        self._dispatcher: Optional[threading.Thread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._workers: Optional[ThreadPoolExecutor] = None
+        self._stopping = False
+        # introspection for tests/bench: fused dispatches issued and
+        # their occupancy (also exported via the Prometheus histogram)
+        self.dispatches = 0
+        self.spans_decoded = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if self._dispatcher is not None:
+            return
+        with self._start_lock:
+            if self._dispatcher is not None or self._stopping:
+                return
+            self._rs = ReedSolomon(backend=self.backend)
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.readers,
+                thread_name_prefix="reads-fetch")
+            # batches process on a small worker pool, NOT on the
+            # dispatcher: a batch wedged behind one blackholed peer
+            # must stall only itself, never batch formation for
+            # healthy volumes (head-of-line containment). The
+            # semaphore mirrors the pool width so the dispatcher can
+            # tell when every worker is busy — and keep accumulating
+            # instead of queueing micro-batches behind them.
+            self._workers = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="reads-batch")
+            self._slots = threading.Semaphore(2)
+            t = threading.Thread(target=self._run, name="reads-decode",
+                                 daemon=True)
+            t.start()
+            self._dispatcher = t
+
+    def stop(self) -> None:
+        with self._start_lock:
+            self._stopping = True
+            if self._dispatcher is None:
+                return
+        self._q.put(None)
+        self._dispatcher.join(timeout=10)
+        if self._workers is not None:
+            self._workers.shutdown(wait=True)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        # requests that slipped in between the dispatcher's final
+        # drain and its exit must not wait out their 60s timeout
+        self._fail_pending("decode fleet stopped")
+
+    # -- serving surface ----------------------------------------------------
+
+    def decode(self, ecv, missing_shard: int, offset: int, length: int,
+               remote_reader: Optional[Callable] = None) -> bytes:
+        """Reconstruct one interval of `ecv`'s missing shard. Blocks
+        until the fused batch containing it retires; raises
+        EcShardNotFound when fewer than 10 source rows are reachable."""
+        from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
+        self._ensure_started()
+        if self._stopping:
+            raise EcShardNotFound(
+                f"vid {ecv.volume_id} shard {missing_shard}: decode "
+                "fleet stopped")
+        req = _Request(ecv, missing_shard, offset, length, remote_reader)
+        self._q.put(req)
+        if self._stopping:
+            # stop() may have drained the queue between our check and
+            # the put — fail whatever is queued (including req) now
+            # rather than letting callers wait out the full timeout
+            self._fail_pending("decode fleet stopped")
+        if not req.done.wait(timeout=60):
+            req.error = EcShardNotFound(
+                f"vid {ecv.volume_id} shard {missing_shard}: decode "
+                "fleet timed out")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            req = self._q.get()
+            if req is None:
+                self._fail_pending("decode fleet stopped")
+                return
+            batch = [req]
+            deadline = time.monotonic() + self.batch_window_s
+            while len(batch) < self.max_batch:
+                try:
+                    # whatever is ALREADY queued fuses for free; the
+                    # blocking window only opens once the batch proves
+                    # concurrent — a lone request never waits
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    if len(batch) == 1:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                if nxt is None:
+                    self._submit(batch)
+                    self._fail_pending("decode fleet stopped")
+                    return
+                batch.append(nxt)
+            # while every worker is busy, keep draining the queue into
+            # THIS batch — the accumulation that makes fused decode
+            # dispatches full exactly when decode is the bottleneck.
+            # An idle fleet takes a slot immediately: a lone request
+            # still never waits.
+            got_slot = self._slots.acquire(blocking=False)
+            while not got_slot and len(batch) < self.max_batch:
+                try:
+                    nxt = self._q.get(timeout=0.002)
+                except queue.Empty:
+                    pass
+                else:
+                    if nxt is None:
+                        self._slots.acquire()
+                        self._submit(batch, have_slot=True)
+                        self._fail_pending("decode fleet stopped")
+                        return
+                    batch.append(nxt)
+                got_slot = self._slots.acquire(blocking=False)
+            if not got_slot:
+                self._slots.acquire()  # batch full: wait for a worker
+            self._submit(batch, have_slot=True)
+
+    def _submit(self, batch: List[_Request], have_slot: bool = False) -> None:
+        if not have_slot:
+            self._slots.acquire()
+        self._workers.submit(self._process_guarded, batch)
+
+    def _process_guarded(self, batch: List[_Request]) -> None:
+        try:
+            self._process(batch)
+        except BaseException as e:  # noqa: BLE001 - latch, never die
+            log.exception("degraded decode batch failed")
+            for r in batch:
+                if r.error is None and r.result is None:
+                    r.error = e
+                r.done.set()
+        finally:
+            self._slots.release()
+
+    def _fail_pending(self, why: str) -> None:
+        from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if r is not None:
+                r.error = EcShardNotFound(why)
+                r.done.set()
+
+    def _process(self, batch: List[_Request]) -> None:
+        sp = trace.span("reads.batch", spans=len(batch)) \
+            if trace.is_enabled() else trace.NOOP
+        with sp:
+            self._fetch_rows(batch)
+            self._solve(batch)
+        for req in batch:
+            req.done.set()
+
+    def _fetch_rows(self, batch: List[_Request]) -> None:
+        """Gather 10 source rows per request, overlapped across the
+        batch: all local reads first (parallel), then remote fetches
+        only for each request's deficit."""
+        # phase A: every request's local shard reads, in flight at once
+        for req in batch:
+            req._local_futs = []
+            for sid in range(TOTAL_SHARDS):
+                if sid == req.missing:
+                    continue
+                shard = req.ecv.shards.get(sid)
+                if shard is not None:
+                    req._local_futs.append((sid, self._pool.submit(
+                        _read_local, shard, req.offset, req.length)))
+        # phase B: collect locals; submit the remote deficit (+1 slack)
+        for req in batch:
+            local_ok = set()
+            for sid, fut in req._local_futs:
+                b = _await_row(fut)
+                if b is not None and len(req.ids) < DATA_SHARDS:
+                    req.ids.append(sid)
+                    req.rows.append(np.frombuffer(b, dtype=np.uint8))
+                    local_ok.add(sid)
+            req._candidates = [
+                sid for sid in range(TOTAL_SHARDS)
+                if sid != req.missing and sid not in local_ok] \
+                if req.remote_reader is not None else []
+            deficit = DATA_SHARDS - len(req.ids)
+            req._remote_futs = []
+            if deficit > 0 and req._candidates:
+                take, req._candidates = (req._candidates[:deficit + 1],
+                                         req._candidates[deficit + 1:])
+                for sid in take:
+                    req._remote_futs.append((sid, self._pool.submit(
+                        _read_remote, req.remote_reader, sid,
+                        req.offset, req.length)))
+        # phase C: collect remotes. On a failure the WHOLE remaining
+        # candidate set is submitted at once — chained one-by-one
+        # top-ups would serialize this thread behind each wedged
+        # peer's timeout in turn (head-of-line for the whole fleet)
+        from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
+        for req in batch:
+            futs = list(req._remote_futs)
+            while futs and len(req.ids) < DATA_SHARDS:
+                sid, fut = futs.pop(0)
+                b = _await_row(fut)
+                if b is not None:
+                    if len(req.ids) < DATA_SHARDS:
+                        req.ids.append(sid)
+                        req.rows.append(np.frombuffer(b, dtype=np.uint8))
+                elif req._candidates:
+                    spares, req._candidates = req._candidates, []
+                    futs.extend(
+                        (nxt, self._pool.submit(
+                            _read_remote, req.remote_reader, nxt,
+                            req.offset, req.length))
+                        for nxt in spares)
+            if len(req.ids) < DATA_SHARDS:
+                req.error = EcShardNotFound(
+                    f"vid {req.ecv.volume_id} shard {req.missing}: only "
+                    f"{len(req.ids)} shards reachable, need {DATA_SHARDS}")
+                continue
+            # canonical sid order: locals landed first, remotes after,
+            # so sort rows with ids — the (present, missing) signature
+            # must not depend on discovery order or identical shard
+            # sets split into separate dispatches
+            order = sorted(range(DATA_SHARDS), key=lambda i: req.ids[i])
+            req.rows = [req.rows[i] for i in order]
+            req.ids = [req.ids[i] for i in order]
+
+    def _solve(self, batch: List[_Request]) -> None:
+        """Group healthy requests by decode signature and issue one
+        fused [B, 10, span] reconstruct per group."""
+        groups: Dict[Tuple[Tuple[int, ...], int], List[_Request]] = {}
+        for req in batch:
+            if req.error is not None:
+                continue
+            # ids were sorted at the end of the fetch phase, so the
+            # signature — and hence the decode matrix — is canonical
+            groups.setdefault((tuple(req.ids), req.missing),
+                              []).append(req)
+        for (present, missing), members in groups.items():
+            span = max(r.length for r in members)
+            src = np.zeros((len(members), DATA_SHARDS, span),
+                           dtype=np.uint8)
+            for i, r in enumerate(members):
+                for row, data in enumerate(r.rows):
+                    src[i, row, :len(data)] = data
+            sp = trace.span("reads.decode", batch=len(members),
+                            span=span) if trace.is_enabled() else trace.NOOP
+            try:
+                with sp:
+                    out = self._rs.reconstruct_some(
+                        list(present), [missing], src)  # [B, 1, span]
+            except BaseException as e:  # noqa: BLE001 - latch per group
+                for r in members:
+                    r.error = e
+                continue
+            self.dispatches += 1
+            self.spans_decoded += len(members)
+            ReadsDegradedBatchHistogram.observe(len(members))
+            ReadsDegradedCounter.inc(len(members))
+            for i, r in enumerate(members):
+                r.result = out[i, 0, :r.length].tobytes()
+                ReadsDecodedBytesCounter.inc(float(r.length))

@@ -71,12 +71,18 @@ class ScrubDaemon:
     def __init__(self, store: Store, mbps: float = 0.0,
                  backend: str = "auto", interval_s: float = 0.0,
                  replica_fetch: Optional[Callable] = None,
-                 export_lag: bool = True):
+                 export_lag: bool = True,
+                 on_repair: Optional[Callable[[int], None]] = None):
         self.store = store
         self.mbps = mbps
         self.backend = backend
         self.interval_s = interval_s
         self.replica_fetch = replica_fetch
+        # on_repair(vid) fires after scrub rewrites any bytes of a
+        # volume (needle rewrite or EC shard reconstruction) — the
+        # volume server hangs read-cache invalidation here so a repair
+        # can never serve a pre-repair cached blob
+        self.on_repair = on_repair
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._resume = threading.Event()
@@ -260,6 +266,8 @@ class ScrubDaemon:
                         res.corruptions_repaired += 1
                         ScrubCorruptionsRepairedCounter.labels(
                             "needle").inc()
+                        if self.on_repair is not None:
+                            self.on_repair(vid)
                         res.details.append(
                             f"volume {vid}: needle {n.id:x} rewritten "
                             f"from replica")
@@ -370,6 +378,8 @@ class ScrubDaemon:
                     f"ec volume {vid}: rebuild of {bad} failed: {e}")
                 log.error("ec volume %d: rebuild failed: %s", vid, e)
                 return
+            if self.on_repair is not None:
+                self.on_repair(vid)
             vr = planner.verify_ec_repair(damage.base,
                                           backend=self.backend)
             res.stripes_verified += vr.spans

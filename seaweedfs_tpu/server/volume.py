@@ -70,7 +70,11 @@ class VolumeServer:
                  storage_backends: Optional[dict] = None,
                  needle_map_kind: str = "memory",
                  scrub_mbps: float = 0.0,
-                 scrub_interval_s: float = 0.0):
+                 scrub_interval_s: float = 0.0,
+                 cache_size_mb: int = 0,
+                 cache_dir: Optional[str] = None,
+                 degraded_fleet: bool = True,
+                 degraded_batch_ms: float = 2.0):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -91,13 +95,34 @@ class VolumeServer:
         self.store = Store(directories, max_volume_counts, ip=ip, port=port,
                            public_url=public_url,
                            needle_map_kind=needle_map_kind)
+        # tiered read cache (-cache.sizeMB/-cache.dir): absent — not
+        # merely empty — unless sized, so the disabled read path never
+        # pays a lookup (test_perf_gates.test_cache_disabled_overhead)
+        self.read_cache = None
+        if cache_size_mb > 0:
+            from seaweedfs_tpu.cache import TieredReadCache
+            self.read_cache = TieredReadCache(
+                cache_size_mb << 20,
+                disk_dir=os.path.join(cache_dir, f"rc{port}")
+                if cache_dir else None)
+        # degraded-read decode fleet: fuses concurrent on-the-fly RS
+        # reconstructions into [B, 10, span] dispatches. Constructing
+        # it spawns nothing; threads appear on the first degraded read
+        # (test_perf_gates.test_degraded_decode_disabled_overhead).
+        self.degraded = None
+        if degraded_fleet:
+            from seaweedfs_tpu.reads import DegradedReadFleet
+            self.degraded = DegradedReadFleet(
+                backend=ec_encoder,
+                batch_window_s=degraded_batch_ms / 1000.0)
         # background integrity scrub: costs nothing (no thread, no IO)
         # until started — by RPC, by the master's staggered scheduler,
         # or at boot when -scrub.intervalSeconds is set
         self.scrub = ScrubDaemon(
             self.store, mbps=scrub_mbps, backend=ec_encoder,
             interval_s=scrub_interval_s,
-            replica_fetch=self._fetch_needle_from_replica)
+            replica_fetch=self._fetch_needle_from_replica,
+            on_repair=self._invalidate_volume_cache)
         self.scrub_interval_s = scrub_interval_s
         self.volume_size_limit = 30 << 30
         self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
@@ -140,6 +165,8 @@ class VolumeServer:
     def stop(self) -> None:
         log.info("volume server %s:%d stopping", self.ip, self.port)
         self._stopping = True
+        if self.degraded is not None:
+            self.degraded.stop()
         self.scrub.stop()
         self._hb_wake.set()
         if self._hb_call is not None:
@@ -659,6 +686,9 @@ class VolumeServer:
                 backend=request.encoder or self.ec_encoder)
         except EcShardNotFound as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        if rebuilt:
+            # rebuilt shard bytes supersede any reconstructed spans
+            self._invalidate_volume_cache(request.volume_id, "rebuild")
         return volume_server_pb2.VolumeEcShardsRebuildResponse(
             rebuilt_shard_ids=rebuilt)
 
@@ -685,6 +715,8 @@ class VolumeServer:
         store_ec.delete_ec_shards(self.store, request.volume_id,
                                   collection=request.collection or None,
                                   shard_ids=list(request.shard_ids))
+        # the shard set changed under any cached reconstructed spans
+        self._invalidate_volume_cache(request.volume_id, "rebuild")
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeEcShardsDeleteResponse()
 
@@ -719,7 +751,7 @@ class VolumeServer:
         try:
             store_ec.delete_ec_needle(
                 self.store, request.volume_id,
-                Needle(id=request.file_key))
+                Needle(id=request.file_key), cache=self.read_cache)
         except EcShardNotFound as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return volume_server_pb2.VolumeEcBlobDeleteResponse()
@@ -731,6 +763,9 @@ class VolumeServer:
                                          backend=self.ec_encoder)
         except EcShardNotFound as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        # the vid serves from a normal volume now: EC-era cache entries
+        # must not outlive the transition (writes can land again)
+        self._invalidate_volume_cache(request.volume_id, "rebuild")
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeEcShardsToVolumeResponse()
 
@@ -849,16 +884,32 @@ class VolumeServer:
         if self.store.find_ec_volume(vid) is not None:
             return store_ec.read_ec_needle(
                 self.store, vid, n,
-                remote_reader=self._make_remote_reader(vid))
+                remote_reader=self._make_remote_reader(vid),
+                cache=self.read_cache, decoder=self.degraded)
         raise NeedleError(f"volume {vid} not found")
 
     def _delete_needle(self, vid: int, n: Needle) -> int:
         if self.store.has_volume(vid):
-            return self.store.delete_needle(vid, n)
+            size = self.store.delete_needle(vid, n)
+            self._invalidate_needle_cache(vid, n.id, "delete")
+            return size
         if self.store.find_ec_volume(vid) is not None:
-            store_ec.delete_ec_needle(self.store, vid, n)
+            store_ec.delete_ec_needle(self.store, vid, n,
+                                      cache=self.read_cache)
             return 0
         raise NeedleError(f"volume {vid} not found")
+
+    # -- read-cache invalidation ----------------------------------------------
+
+    def _invalidate_needle_cache(self, vid: int, needle_id: int,
+                                 reason: str) -> None:
+        if self.read_cache is not None:
+            self.read_cache.invalidate(vid, needle_id, reason)
+
+    def _invalidate_volume_cache(self, vid: int,
+                                 reason: str = "scrub_repair") -> None:
+        if self.read_cache is not None:
+            self.read_cache.invalidate_volume(vid, reason)
 
     def _make_remote_reader(self, vid: int):
         def remote_reader(shard_id: int, offset: int, length: int):
@@ -868,11 +919,15 @@ class VolumeServer:
                     continue
                 tried = True
                 try:
+                    # deadline: a hung peer must fail this row, not pin
+                    # the caller (the decode fleet's dispatcher rides
+                    # this reader — head-of-line blocking is fatal there)
                     chunks = [r.data for r in volume_stub(url)
                               .VolumeEcShardRead(
                                   volume_server_pb2.VolumeEcShardReadRequest(
                                       volume_id=vid, shard_id=shard_id,
-                                      offset=offset, size=length))]
+                                      offset=offset, size=length),
+                                  timeout=15)]
                     data = b"".join(chunks)
                     if len(data) == length:
                         return data
@@ -956,6 +1011,7 @@ class VolumeServer:
         if v is not None and v.read_only:
             raise NeedleError(f"volume {vid} is read only")
         _, size = self.store.write_needle(vid, n, fsync=fsync)
+        self._invalidate_needle_cache(vid, n.id, "overwrite")
         if v is not None and v.replica_placement.copy_count <= 1:
             return size
         blob = n.to_bytes()
@@ -1140,6 +1196,8 @@ def _make_http_handler(vs: VolumeServer):
                             for loc in vs.store.locations
                             for v in loc.volumes.values()],
                 "Scrub": vs.scrub.status(),
+                "Cache": vs.read_cache.stats()
+                if vs.read_cache is not None else {"enabled": False},
             }
 
         def _redirect_to_replica(self, f) -> None:
@@ -1330,6 +1388,7 @@ def _make_http_handler(vs: VolumeServer):
             try:
                 n = Needle.from_bytes(self._body())
                 vs.store.write_needle(vid, n)
+                vs._invalidate_needle_cache(vid, n.id, "overwrite")
             except NeedleError as e:
                 self._json({"error": str(e)}, code=500)
                 return

@@ -302,6 +302,46 @@ ScrubScanLagGauge = REGISTRY.gauge(
     "SeaweedFS_scrub_scan_lag_seconds",
     "seconds since the last completed scrub pass")
 
+# Read-serving families (seaweedfs_tpu/reads/, ec/ec_volume.py): the
+# degraded-read path's ledger — how much traffic is riding RS
+# reconstruction instead of healthy shards, and how well the decode
+# fleet fuses it.
+ReadsDegradedCounter = REGISTRY.counter(
+    "SeaweedFS_reads_degraded_total",
+    "intervals served by on-the-fly RS reconstruction")
+ReadsDegradedBatchHistogram = REGISTRY.histogram(
+    "SeaweedFS_reads_degraded_batch_spans",
+    "reconstruction spans fused into one RS decode dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+ReadsDecodedBytesCounter = REGISTRY.counter(
+    "SeaweedFS_reads_decoded_bytes_total",
+    "bytes produced by read-path RS reconstruction")
+ReadsShortShardCounter = REGISTRY.counter(
+    "SeaweedFS_reads_short_shard_total",
+    "local shard reads that came back short (shard truncated on disk) "
+    "and fell into reconstruction", ("vid", "shard"))
+ReadsSingleFlightWaitCounter = REGISTRY.counter(
+    "SeaweedFS_reads_singleflight_waits_total",
+    "reads that waited on another thread's in-flight reconstruction "
+    "instead of launching their own")
+
+# Tiered read cache families (seaweedfs_tpu/cache/): hit/miss/admit/
+# evict per tier plus invalidation reasons, so operators can see both
+# how hot the cache runs and why entries leave it.
+CacheHitCounter = REGISTRY.counter(
+    "SeaweedFS_cache_hits_total", "read cache hits", ("tier",))
+CacheMissCounter = REGISTRY.counter(
+    "SeaweedFS_cache_misses_total", "read cache misses (all tiers)")
+CacheAdmitCounter = REGISTRY.counter(
+    "SeaweedFS_cache_admitted_total", "entries admitted", ("tier",))
+CacheEvictCounter = REGISTRY.counter(
+    "SeaweedFS_cache_evictions_total", "entries evicted", ("tier",))
+CacheInvalidateCounter = REGISTRY.counter(
+    "SeaweedFS_cache_invalidations_total",
+    "entries dropped by invalidation", ("reason",))
+CacheBytesGauge = REGISTRY.gauge(
+    "SeaweedFS_cache_bytes", "bytes resident per cache tier", ("tier",))
+
 
 # -- shared request instrumentation -------------------------------------------
 #

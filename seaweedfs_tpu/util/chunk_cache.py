@@ -1,9 +1,13 @@
-"""Tiered chunk cache: memory LRU + size-classed on-disk tiers
+"""Tiered chunk cache: memory SLRU + size-classed on-disk tiers
 (reference: weed/util/chunk_cache/chunk_cache.go:16-130).
 
 The reference caches chunks ≤1MB in memory, and on disk in three tiers
 keyed by chunk size (≤1MB, ≤4MB, bigger). Here the on-disk tiers are
-directories of fid-named files with byte-budget LRU eviction.
+directories of fid-named files with byte-budget LRU eviction, and the
+memory tier rides `cache.SegmentedLRU` — the same scan-resistant
+probation/protected policy the volume server's read cache uses, so one
+`filer.copy` of a large tree can no longer flush the filer's hot chunk
+set.
 """
 
 from __future__ import annotations
@@ -13,34 +17,28 @@ import threading
 from collections import OrderedDict
 from typing import Optional
 
+from seaweedfs_tpu.cache.read_cache import SegmentedLRU
+
 MEM_UNIT = 1 << 20        # chunks up to 1MB may live in memory
 DISK_UNITS = (1 << 20, 4 << 20)   # tier boundaries
 
 
 class MemCache:
+    """Byte-bounded RAM tier over SegmentedLRU (scan-resistant: new
+    chunks enter probation; only a second touch protects them)."""
+
     def __init__(self, limit_bytes: int):
         self.limit = limit_bytes
-        self._lock = threading.Lock()
-        self._data: OrderedDict[str, bytes] = OrderedDict()
-        self._bytes = 0
+        # items up to the full budget stay admissible (the historical
+        # MemCache contract; TieredChunkCache already routes oversized
+        # chunks to disk by size class)
+        self._lru = SegmentedLRU(limit_bytes, max_item_bytes=limit_bytes)
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._lock:
-            v = self._data.get(key)
-            if v is not None:
-                self._data.move_to_end(key)
-            return v
+        return self._lru.get(key)
 
     def set(self, key: str, value: bytes) -> None:
-        with self._lock:
-            old = self._data.pop(key, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._data[key] = value
-            self._bytes += len(value)
-            while self._bytes > self.limit and self._data:
-                _, evicted = self._data.popitem(last=False)
-                self._bytes -= len(evicted)
+        self._lru.set(key, value)
 
 
 class DiskTier:

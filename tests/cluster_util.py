@@ -42,7 +42,8 @@ class Cluster:
                  pulse_seconds: float = 0.2,
                  ec_encoder: str = "numpy",
                  with_filer: bool = False,
-                 filer_kwargs: Optional[dict] = None):
+                 filer_kwargs: Optional[dict] = None,
+                 volume_kwargs: Optional[dict] = None):
         self.master = MasterServer(
             port=free_port_pair(),
             meta_dir=str(tmp_path / "master"),
@@ -68,7 +69,8 @@ class Cluster:
                     master_url=self.master.url, directories=[str(d)],
                     port=free_port_pair(),
                     max_volume_counts=[volumes_per_server],
-                    pulse_seconds=pulse_seconds, ec_encoder=ec_encoder)
+                    pulse_seconds=pulse_seconds, ec_encoder=ec_encoder,
+                    **(volume_kwargs or {}))
                 vs.start()
                 self.volume_servers.append(vs)
             if with_filer:

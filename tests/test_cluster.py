@@ -479,6 +479,167 @@ def test_metrics_expose_fleet_stages_after_ec_encode(tmp_path):
         c.stop()
 
 
+def test_degraded_read_fleet_and_cache_end_to_end(tmp_path):
+    """ISSUE 4 acceptance: kill 2 shards of an EC volume and hammer the
+    same key range — the first reads reconstruct via fused fleet
+    batches (occupancy recorded), repeat reads are cache hits with
+    ZERO new RS dispatches, bytes stay identical to the healthy-volume
+    read, and invalidation is proven on the scrub-repair and overwrite
+    paths."""
+    import threading
+
+    c = Cluster(tmp_path, n_volume_servers=1,
+                volume_kwargs={"cache_size_mb": 16,
+                               "degraded_batch_ms": 20.0})
+    vs = c.volume_servers[0]
+    stub = volume_stub(vs.url)
+    try:
+        datas = [os.urandom(1500) for _ in range(24)]
+        fids = [c.upload(d, collection="deg") for d in datas]
+        by_vid = {}
+        for fid, d in zip(fids, datas):
+            by_vid.setdefault(parse_fid(fid).volume_id, []).append(
+                (fid, d))
+        vid, keep = max(by_vid.items(), key=lambda kv: len(kv[1]))
+        assert len(keep) >= 3, by_vid
+        stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid))
+        stub.VolumeEcShardsGenerate(
+            volume_server_pb2.VolumeEcShardsGenerateRequest(
+                volume_id=vid, collection="deg", encoder="numpy"))
+        stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection="deg",
+                shard_ids=list(range(14))))
+        stub.VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid))
+        cluster_ready = c.wait_for(
+            lambda: c.master.topo.lookup_ec(vid),
+            what="ec shards in topology")
+        assert cluster_ready
+
+        # the healthy-volume reference bytes
+        healthy = {}
+        for fid, d in keep:
+            with c.fetch(fid) as r:
+                healthy[fid] = r.read()
+            assert healthy[fid] == d
+
+        # kill/remove 2 data shards -> every read needs reconstruction
+        lost = [0, 3]
+        stub.VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=lost))
+        stub.VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection="deg", shard_ids=lost))
+
+        assert vs.degraded is not None and vs.read_cache is not None
+        d0 = vs.degraded.dispatches
+        errs = []
+
+        def hammer(fid):
+            try:
+                with c.fetch(fid) as r:
+                    assert r.read() == healthy[fid], \
+                        "degraded bytes differ from healthy read"
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=hammer, args=(fid,))
+              for fid, _ in keep]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[:2]
+        assert vs.degraded.dispatches > d0, \
+            "degraded reads never reached the fused decode fleet"
+        with c.http(f"{c.metrics_url}/metrics") as r:
+            text = r.read().decode()
+
+        def sample(line_prefix):
+            for line in text.splitlines():
+                if line.startswith(line_prefix):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"no sample starting {line_prefix!r}")
+
+        # fused-batch occupancy was recorded, as were decoded bytes
+        assert sample("SeaweedFS_reads_degraded_batch_spans_count") > 0
+        assert sample("SeaweedFS_reads_decoded_bytes_total") > 0
+        assert sample('SeaweedFS_cache_admitted_total{tier="mem"}') > 0
+
+        # repeat reads: cache hits, ZERO new RS dispatches
+        d1 = vs.degraded.dispatches
+        hits0 = vs.read_cache.hits
+        for _ in range(3):
+            for fid, _ in keep:
+                with c.fetch(fid) as r:
+                    assert r.read() == healthy[fid]
+        assert vs.degraded.dispatches == d1, \
+            "repeat reads issued new RS dispatches past the cache"
+        assert vs.read_cache.hits > hits0
+        # the /status page carries the Cache block
+        with c.http(f"{vs.url}/status") as r:
+            st = json.load(r)
+        assert st["Cache"]["enabled"] and st["Cache"]["hits"] > 0
+
+        # restore the lost shards; the rebuild invalidates the cache
+        inv0 = vs.read_cache.invalidations
+        resp = stub.VolumeEcShardsRebuild(
+            volume_server_pb2.VolumeEcShardsRebuildRequest(
+                volume_id=vid, collection="deg", encoder="numpy"))
+        assert sorted(resp.rebuilt_shard_ids) == lost
+        stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection="deg", shard_ids=lost))
+        assert vs.read_cache.invalidations > inv0, \
+            "shard rebuild must invalidate cached entries"
+
+        # scrub-repair invalidation: warm the cache with fresh reads,
+        # corrupt a shard, scrub -> repaired AND the volume's cache
+        # dropped (a repair must never serve pre-repair cached blobs)
+        for fid, _ in keep:
+            with c.fetch(fid) as r:
+                assert r.read() == healthy[fid]
+        assert vs.read_cache.stats()["volumes"] >= 1
+        from seaweedfs_tpu.ec.encoder import shard_file_name
+        base = vs.store.find_ec_volume(vid).base_name
+        shard_path = shard_file_name(base, 2)
+        with open(shard_path, "r+b") as f:
+            f.seek(os.path.getsize(shard_path) // 2)
+            byte = f.read(1)
+            f.seek(os.path.getsize(shard_path) // 2)
+            f.write(bytes([byte[0] ^ 0x5A]))
+        inv1 = vs.read_cache.invalidations
+        res = vs.scrub.run_pass(volume_ids=[vid])
+        assert res.corruptions_repaired >= 1, res
+        assert vs.read_cache.invalidations > inv1, \
+            "scrub repair must invalidate cached entries"
+        for fid, _ in keep:  # fresh, correct bytes after repair
+            with c.fetch(fid) as r:
+                assert r.read() == healthy[fid]
+
+        # overwrite invalidation: decode back to a normal volume and
+        # overwrite one blob — the read must serve the fresh bytes
+        stub.VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=list(range(14))))
+        stub.VolumeEcShardsToVolume(
+            volume_server_pb2.VolumeEcShardsToVolumeRequest(
+                volume_id=vid, collection="deg"))
+        c.wait_for(lambda: c.master.topo.lookup(vid, "deg"),
+                   what="decoded volume back in topology")
+        fid0, _ = keep[0]
+        fresh = os.urandom(900)
+        with c.http(f"{vs.url}/{fid0}", data=fresh, method="POST") as r:
+            assert r.status == 201
+        with c.fetch(fid0) as r:
+            assert r.read() == fresh, "overwrite served stale bytes"
+    finally:
+        c.stop()
+
+
 def test_admin_ui_pages(cluster):
     """Master and volume servers serve plain HTML status pages
     (reference server/*_ui)."""

@@ -1,0 +1,433 @@
+"""Degraded-read coverage: multi-shard loss through the decode fleet
+and the in-place parallel fallback, remote-reader failure modes,
+short-shard accounting, and single-flight under concurrency."""
+
+import os
+import random
+import threading
+
+import pytest
+
+from seaweedfs_tpu import ec
+from seaweedfs_tpu.cache import TieredReadCache
+from seaweedfs_tpu.ec import store_ec
+from seaweedfs_tpu.ec.ec_volume import EcShardNotFound, EcVolume
+from seaweedfs_tpu.reads import DegradedReadFleet
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+LARGE = 2048
+SMALL = 256
+
+
+@pytest.fixture
+def ec_fixture(tmp_path):
+    """An encoded EC volume with ~40KB of known needles; yields
+    (directory, payloads, base)."""
+    d = str(tmp_path)
+    v = Volume(d, "", 1)
+    rng = random.Random(11)
+    payloads = {}
+    for i in range(1, 31):
+        data = bytes(rng.getrandbits(8)
+                     for _ in range(rng.randint(10, 3000)))
+        v.write_needle(Needle(id=i, cookie=0xC0 + i, data=data))
+        payloads[i] = data
+    v.close()
+    base = os.path.join(d, "1")
+    ec.write_ec_files(base, backend="numpy", large_block=LARGE,
+                      small_block=SMALL, chunk=512)
+    ec.write_sorted_file_from_idx(base)
+    return d, payloads, base
+
+
+def mount_with_loss(d, lost):
+    ecv = EcVolume(d, "", 1, large_block=LARGE, small_block=SMALL)
+    for i in range(14):
+        if i not in lost:
+            ecv.mount_shard(i)
+    return ecv
+
+
+@pytest.fixture
+def fleet():
+    f = DegradedReadFleet(backend="numpy")
+    yield f
+    f.stop()
+
+
+@pytest.mark.parametrize("lost", [
+    (0, 5),            # 2 data shards
+    (10, 13),          # 2 parity shards (healthy needle reads, but
+                       # reconstruction sources shrink)
+    (1, 7, 11),        # mixed: 2 data + 1 parity
+    (2, 4, 6, 12),     # max tolerable: 3 data + 1 parity
+])
+def test_multi_shard_loss_through_fleet(ec_fixture, fleet, lost):
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, lost)
+    try:
+        for key, want in payloads.items():
+            got = ecv.read_needle(Needle(id=key, cookie=0xC0 + key),
+                                  decoder=fleet)
+            assert got.data == want, f"lost={lost} key={key}"
+    finally:
+        ecv.close()
+
+
+def test_multi_shard_loss_in_place_fallback_matches(ec_fixture):
+    """The parallel in-place fallback (fleet disabled) must stay
+    byte-identical to healthy reads — satellite 1's contract."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0, 3, 11, 13))
+    try:
+        for key, want in payloads.items():
+            got = ecv.read_needle(Needle(id=key, cookie=0xC0 + key))
+            assert got.data == want
+    finally:
+        ecv.close()
+
+
+def test_five_lost_shards_is_unrecoverable(ec_fixture, fleet):
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0, 1, 2, 3, 4))
+    try:
+        with pytest.raises(EcShardNotFound):
+            ecv.read_needle(Needle(id=1, cookie=0xC1), decoder=fleet)
+        # the same loss through the fallback path agrees
+        with pytest.raises(EcShardNotFound):
+            ecv.read_needle(Needle(id=1, cookie=0xC1))
+    finally:
+        ecv.close()
+
+
+class _FlakyRemote:
+    """remote_reader stand-in sourcing from shard files on disk, with
+    programmable failures: raise / short data / None per shard id."""
+
+    def __init__(self, base, fail=(), short=(), silent=()):
+        self.base = base
+        self.fail = set(fail)
+        self.short = set(short)
+        self.silent = set(silent)
+        self.calls = []
+
+    def __call__(self, sid, offset, length):
+        self.calls.append(sid)
+        if sid in self.fail:
+            raise OSError(f"shard {sid} peer unreachable")
+        if sid in self.silent:
+            return None
+        with open(ec.shard_file_name(self.base, sid), "rb") as f:
+            f.seek(offset)
+            b = f.read(length)
+        if sid in self.short:
+            return b[:max(0, len(b) - 1)]
+        return b + b"\x00" * (length - len(b))
+
+
+@pytest.mark.parametrize("use_fleet", [True, False])
+def test_remote_errors_and_short_data_mid_reconstruction(
+        ec_fixture, fleet, use_fleet):
+    """Only 8 shards local: reconstruction must top up from remotes
+    while tolerating raising, short-data, and None-returning peers."""
+    d, payloads, base = ec_fixture
+    # local: shards 2..9 (8 data shards); lost everywhere: none — but
+    # shards 0,1,10..13 are only reachable remotely
+    ecv = mount_with_loss(d, (0, 1, 10, 11, 12, 13))
+    remote = _FlakyRemote(base, fail=(10,), short=(11,), silent=(12,))
+    try:
+        for key, want in list(payloads.items())[:10]:
+            got = ecv.read_needle(Needle(id=key, cookie=0xC0 + key),
+                                  remote_reader=remote,
+                                  decoder=fleet if use_fleet else None)
+            assert got.data == want
+        assert remote.calls, "remote reader never consulted"
+    finally:
+        ecv.close()
+
+
+def test_remote_total_failure_latches_only_that_read(ec_fixture, fleet):
+    """Per-request error latching: a volume whose remotes are all dead
+    fails alone; a healthy volume's requests in the same fleet batch
+    still decode."""
+    d, payloads, base = ec_fixture
+    bad = mount_with_loss(d, (0, 1, 2, 10, 11, 12, 13))  # 7 local only
+    good = mount_with_loss(d, (0, 5))
+    dead = _FlakyRemote(base, fail=range(14))
+    errs, oks = [], []
+
+    def read_bad():
+        try:
+            bad.read_needle(Needle(id=1, cookie=0xC1),
+                            remote_reader=dead, decoder=fleet)
+        except EcShardNotFound as e:
+            errs.append(e)
+
+    def read_good(key):
+        got = good.read_needle(Needle(id=key, cookie=0xC0 + key),
+                               decoder=fleet)
+        oks.append(got.data == payloads[key])
+
+    ts = [threading.Thread(target=read_bad)] + \
+        [threading.Thread(target=read_good, args=(k,))
+         for k in list(payloads)[:6]]
+    try:
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(errs) == 1, "unreachable volume must fail its read"
+        assert oks and all(oks), "healthy reads poisoned by the bad one"
+    finally:
+        bad.close()
+        good.close()
+
+
+def test_short_local_shard_counted_and_recovered(ec_fixture, fleet):
+    """Satellite 2: a truncated local shard is detected (counter +
+    one log), and the read still returns correct bytes."""
+    from seaweedfs_tpu.stats.metrics import ReadsShortShardCounter
+    d, payloads, base = ec_fixture
+    # truncate shard 2 to half size AFTER computing which needle lands
+    # in it — every read crossing it now short-reads
+    p = ec.shard_file_name(base, 2)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size // 2)
+    ecv = mount_with_loss(d, ())
+    try:
+        child = ReadsShortShardCounter.labels("1", "2")
+        before = child.value
+        for key, want in payloads.items():
+            got = ecv.read_needle(Needle(id=key, cookie=0xC0 + key),
+                                  decoder=fleet)
+            assert got.data == want
+        assert child.value > before, "short shard reads not counted"
+        assert ecv._short_logged == {2}, "log-once set wrong"
+    finally:
+        ecv.close()
+
+
+def test_concurrent_degraded_reads_single_flight(ec_fixture, fleet):
+    """Concurrent reads of the SAME needle behind a cache run ONE
+    reconstruction; the rest wait and hit the cache."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0, 3))
+    cache = TieredReadCache(4 << 20)
+
+    class FakeStore:
+        def find_ec_volume(self, vid):
+            return ecv
+
+    reconstructions = []
+    orig = EcVolume.read_needle_blob
+
+    def counting(self, *a, **kw):
+        reconstructions.append(1)
+        return orig(self, *a, **kw)
+
+    EcVolume.read_needle_blob = counting
+    barrier = threading.Barrier(8)
+    results = []
+
+    def reader():
+        barrier.wait()
+        got = store_ec.read_ec_needle(
+            FakeStore(), 1, Needle(id=7, cookie=0xC7),
+            cache=cache, decoder=fleet)
+        results.append(got.data)
+
+    try:
+        ts = [threading.Thread(target=reader) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        EcVolume.read_needle_blob = orig
+        ecv.close()
+    assert len(results) == 8
+    assert all(r == payloads[7] for r in results)
+    assert len(reconstructions) == 1, \
+        f"{len(reconstructions)} reconstructions for one hot needle"
+
+
+def test_fleet_fuses_concurrent_requests(ec_fixture):
+    """A concurrent burst of DISTINCT degraded reads fuses into shared
+    [B, 10, span] dispatches instead of one dispatch per interval."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0,))
+    # generous window so the whole burst lands in one batch window
+    f = DegradedReadFleet(backend="numpy", batch_window_s=0.25)
+    errs = []
+
+    def reader(key):
+        try:
+            barrier.wait()
+            got = ecv.read_needle(Needle(id=key, cookie=0xC0 + key),
+                                  decoder=f)
+            assert got.data == payloads[key]
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append(e)
+
+    # pick needles that actually cross shard 0
+    degraded_keys = []
+    for key in payloads:
+        _, _, intervals = ecv.locate_needle(key)
+        if any(iv.to_shard_and_offset(LARGE, SMALL)[0] == 0
+               for iv in intervals):
+            degraded_keys.append(key)
+        if len(degraded_keys) == 8:
+            break
+    assert len(degraded_keys) >= 4, "fixture too small for the burst"
+    barrier = threading.Barrier(len(degraded_keys))
+    try:
+        ts = [threading.Thread(target=reader, args=(k,))
+              for k in degraded_keys]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[:2]
+        assert f.spans_decoded >= len(degraded_keys)
+        assert f.dispatches < f.spans_decoded, \
+            f"{f.dispatches} dispatches for {f.spans_decoded} spans — " \
+            "nothing fused"
+    finally:
+        f.stop()
+        ecv.close()
+
+
+def test_fleet_lone_request_does_not_hang(ec_fixture):
+    """Small-batch timeout: a single request decodes within the batch
+    window, it never waits for company."""
+    import time
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (4,))
+    f = DegradedReadFleet(backend="numpy", batch_window_s=0.005)
+    try:
+        t0 = time.perf_counter()
+        got = ecv.read_needle(Needle(id=2, cookie=0xC2), decoder=f)
+        dt = time.perf_counter() - t0
+        assert got.data == payloads[2]
+        assert dt < 2.0, f"lone degraded read took {dt:.2f}s"
+    finally:
+        f.stop()
+        ecv.close()
+
+
+def test_span_cache_serves_repeat_degraded_reads(ec_fixture, fleet):
+    """Repeat degraded reads of the same interval come from the span
+    cache: zero new RS dispatches."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0, 3))
+    cache = TieredReadCache(4 << 20)
+
+    class FakeStore:
+        def find_ec_volume(self, vid):
+            return ecv
+
+    try:
+        for key in payloads:
+            store_ec.read_ec_needle(FakeStore(), 1,
+                                    Needle(id=key, cookie=0xC0 + key),
+                                    cache=cache, decoder=fleet)
+        d0 = fleet.dispatches
+        for key, want in payloads.items():
+            got = store_ec.read_ec_needle(
+                FakeStore(), 1, Needle(id=key, cookie=0xC0 + key),
+                cache=cache, decoder=fleet)
+            assert got.data == want
+        assert fleet.dispatches == d0, \
+            "repeat reads issued new RS dispatches past the cache"
+    finally:
+        ecv.close()
+
+
+def test_poisoned_cache_entry_dropped_and_reread(ec_fixture, fleet):
+    """A cached blob that fails its CRC parse (torn cache file) is
+    evicted and the read served from shards — poison must not turn
+    into a permanent failure for that needle."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0,))
+    cache = TieredReadCache(4 << 20)
+
+    class FakeStore:
+        def find_ec_volume(self, vid):
+            return ecv
+
+    key = cache.needle_key(1, 7)
+    cache.set(key, b"\x00garbage that is not a needle record")
+    try:
+        got = store_ec.read_ec_needle(FakeStore(), 1,
+                                      Needle(id=7, cookie=0xC7),
+                                      cache=cache, decoder=fleet)
+        assert got.data == payloads[7]
+        # the poison was replaced by the good blob: next read hits it
+        h0 = cache.hits
+        got = store_ec.read_ec_needle(FakeStore(), 1,
+                                      Needle(id=7, cookie=0xC7),
+                                      cache=cache, decoder=fleet)
+        assert got.data == payloads[7] and cache.hits > h0
+    finally:
+        ecv.close()
+
+
+def test_poisoned_span_entry_dropped_and_reread(ec_fixture, fleet):
+    """A torn reconstructed-span cache entry (truncated by power loss)
+    must not poison assembled needle blobs: the short hit is dropped
+    and the span re-solved."""
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0,))
+    cache = TieredReadCache(4 << 20)
+
+    class FakeStore:
+        def find_ec_volume(self, vid):
+            return ecv
+
+    # find a degraded interval of needle 7 and seed a TRUNCATED span
+    _, _, intervals = ecv.locate_needle(7)
+    poisoned = 0
+    for iv in intervals:
+        sid, off = iv.to_shard_and_offset(LARGE, SMALL)
+        if sid == 0:
+            cache.set(cache.span_key(1, 0, off, iv.size), b"\x01\x02")
+            poisoned += 1
+    try:
+        got = store_ec.read_ec_needle(FakeStore(), 1,
+                                      Needle(id=7, cookie=0xC7),
+                                      cache=cache, decoder=fleet)
+        assert got.data == payloads[7]
+        if poisoned:  # the torn entries were replaced, reads stay good
+            got = store_ec.read_ec_needle(FakeStore(), 1,
+                                          Needle(id=7, cookie=0xC7),
+                                          cache=cache, decoder=fleet)
+            assert got.data == payloads[7]
+    finally:
+        ecv.close()
+
+
+def test_delete_invalidates_cached_needle(ec_fixture, fleet):
+    from seaweedfs_tpu.storage.needle import NeedleError
+    d, payloads, _ = ec_fixture
+    ecv = mount_with_loss(d, (0,))
+    cache = TieredReadCache(4 << 20)
+
+    class FakeStore:
+        def find_ec_volume(self, vid):
+            return ecv
+
+    try:
+        store_ec.read_ec_needle(FakeStore(), 1, Needle(id=9, cookie=0xC9),
+                                cache=cache, decoder=fleet)
+        assert cache.get(cache.needle_key(1, 9)) is not None
+        store_ec.delete_ec_needle(FakeStore(), 1, Needle(id=9),
+                                  cache=cache)
+        assert cache.get(cache.needle_key(1, 9)) is None
+        with pytest.raises(NeedleError):
+            store_ec.read_ec_needle(FakeStore(), 1,
+                                    Needle(id=9, cookie=0xC9),
+                                    cache=cache, decoder=fleet)
+    finally:
+        ecv.close()

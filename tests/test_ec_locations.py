@@ -31,7 +31,7 @@ class _DeadVolumeStub:
     def __init__(self):
         self.calls = 0
 
-    def VolumeEcShardRead(self, req):
+    def VolumeEcShardRead(self, req, timeout=None):
         self.calls += 1
 
         class _Err(grpc.RpcError):

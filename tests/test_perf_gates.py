@@ -288,6 +288,118 @@ def test_pooled_client_reuses_connections(tmp_path):
         c.stop()
 
 
+def test_cache_disabled_overhead(tmp_path):
+    """The tiered read cache must be zero-cost while disabled (ISSUE 4
+    contract, the scrub/tracing-disabled twin for the read subsystem).
+
+    Three gates. Construction: a volume server built without
+    -cache.sizeMB holds NO cache object at all — the read path's
+    cache branch is a None check, never a lookup. Threads: even a
+    constructed TieredReadCache spawns none (it is pure data
+    structures). Engine: EC needle reads with cache=None hold a
+    generous per-read ceiling — the disabled path must not have grown
+    a hashing/locking tax."""
+    import threading
+
+    from seaweedfs_tpu.cache import TieredReadCache
+    from seaweedfs_tpu.ec import encoder, store_ec
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    def cache_threads():
+        return [t.name for t in threading.enumerate()
+                if "cache" in t.name.lower()]
+
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)])
+    assert vs.read_cache is None, \
+        "default-config server must not construct a read cache"
+    vs.store.close()
+
+    c = TieredReadCache(64 << 20)     # constructed but unwired
+    assert cache_threads() == [], \
+        "constructing the read cache must not spawn threads"
+    del c
+
+    store = Store([str(tmp_path / "ec")])
+    store.add_volume(1)
+    v = store.find_volume(1)
+    blob = bytes(range(256)) * 4
+    n = 400
+    for i in range(1, n + 1):
+        v.write_needle(Needle(id=i, cookie=9, data=blob))
+    v.read_only = True
+    v.sync()
+    base = v.file_name()
+    encoder.write_ec_files(base, backend="numpy")
+    encoder.write_sorted_file_from_idx(base)
+    store.location_of(1).delete_volume(1)
+    store_ec.mount_ec_shards(store, 1, "", range(14))
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        store_ec.read_ec_needle(store, 1, Needle(id=i, cookie=9),
+                                cache=None)
+    read_us = (time.perf_counter() - t0) / n * 1e6
+    store.close()
+    # healthy EC reads measure ~60-120 us here; 1000 us catches the
+    # disabled path growing per-read work without flaking on VM load
+    assert read_us <= 1000, \
+        f"cache-disabled EC read {read_us:.0f} us/needle"
+
+
+def test_degraded_decode_disabled_overhead(tmp_path):
+    """The degraded decode fleet must be zero-cost until a degraded
+    read actually happens (ISSUE 4 contract).
+
+    Construction spawns nothing — no dispatcher, no reader pool — and
+    HEALTHY reads through a server wired with the fleet never touch
+    it: after hundreds of healthy EC needle reads with the decoder
+    passed down the read path, the process still has no reads-* or
+    ec-recover thread."""
+    import threading
+
+    from seaweedfs_tpu.ec import encoder, store_ec
+    from seaweedfs_tpu.reads import DegradedReadFleet
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    def fleet_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith(("reads-", "ec-recover"))]
+
+    baseline = set(fleet_threads())   # earlier tests may have spawned
+    fleet = DegradedReadFleet(backend="numpy")
+    assert set(fleet_threads()) == baseline, \
+        "constructing the decode fleet must not spawn threads"
+
+    store = Store([str(tmp_path / "ec")])
+    store.add_volume(1)
+    v = store.find_volume(1)
+    blob = bytes(range(256)) * 4
+    n = 400
+    for i in range(1, n + 1):
+        v.write_needle(Needle(id=i, cookie=9, data=blob))
+    v.read_only = True
+    v.sync()
+    base = v.file_name()
+    encoder.write_ec_files(base, backend="numpy")
+    encoder.write_sorted_file_from_idx(base)
+    store.location_of(1).delete_volume(1)
+    store_ec.mount_ec_shards(store, 1, "", range(14))
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        store_ec.read_ec_needle(store, 1, Needle(id=i, cookie=9),
+                                decoder=fleet)
+    read_us = (time.perf_counter() - t0) / n * 1e6
+    store.close()
+    assert set(fleet_threads()) == baseline, \
+        "healthy reads must never wake the decode fleet"
+    assert read_us <= 1000, \
+        f"EC read with idle decode fleet {read_us:.0f} us/needle"
+
+
 def test_scrub_disabled_overhead(tmp_path):
     """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
     test_tracing_disabled_overhead twin for the integrity subsystem).

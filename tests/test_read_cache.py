@@ -1,0 +1,245 @@
+"""Tiered read cache unit tests: SLRU admission/scan-resistance, disk
+spill, per-volume invalidation, single-flight."""
+
+import threading
+
+import pytest
+
+from seaweedfs_tpu.cache import DiskCacheTier, SegmentedLRU, TieredReadCache
+
+
+class TestSegmentedLRU:
+    def test_put_get_roundtrip(self):
+        c = SegmentedLRU(1 << 10)
+        assert c.set("k", b"value")
+        assert c.get("k") == b"value"
+        assert c.get("absent") is None
+        assert c.bytes == 5
+
+    def test_second_touch_promotes_and_scan_cannot_flush_hot_set(self):
+        # 1000-byte budget: hot entries are touched twice (protected),
+        # then a single scan of many cold keys churns through — the hot
+        # set must survive because scans never earn protection
+        c = SegmentedLRU(1000, protected_fraction=0.8)
+        for i in range(4):
+            c.set(f"hot{i}", b"x" * 100)
+            assert c.get(f"hot{i}") is not None  # second touch
+        for i in range(50):  # one-touch scan traffic, 5x the budget
+            c.set(f"scan{i}", b"y" * 100)
+        for i in range(4):
+            assert c.get(f"hot{i}") == b"x" * 100, f"hot{i} flushed by scan"
+
+    def test_eviction_drains_probation_first(self):
+        evicted = []
+        c = SegmentedLRU(300, max_item_bytes=100,
+                         on_evict=lambda k, v, p: evicted.append((k, p)))
+        c.set("hot", b"a" * 100)
+        c.get("hot")                    # protected
+        c.set("cold1", b"b" * 100)
+        c.set("cold2", b"c" * 100)
+        c.set("cold3", b"d" * 100)      # over budget
+        assert ("cold1", False) in evicted
+        assert all(k != "hot" for k, _ in evicted)
+
+    def test_protected_eviction_flagged_for_demotion(self):
+        evicted = []
+        c = SegmentedLRU(200, protected_fraction=0.5, max_item_bytes=90,
+                         on_evict=lambda k, v, p: evicted.append((k, p)))
+        c.set("a", b"x" * 90)
+        c.get("a")                      # protected (limit 100)
+        c.set("b", b"y" * 90)
+        c.get("b")                      # protected overflow: a demoted
+        c.set("c", b"z" * 90)           # over total: probation LRU out
+        assert evicted and all(isinstance(p, bool) for _, p in evicted)
+
+    def test_oversized_item_rejected(self):
+        c = SegmentedLRU(800)           # max_item = 100
+        assert not c.set("big", b"x" * 500)
+        assert c.get("big") is None
+        assert c.bytes == 0
+
+    def test_update_in_place_adjusts_bytes(self):
+        c = SegmentedLRU(1 << 10)
+        c.set("k", b"12345")
+        c.set("k", b"123")
+        assert c.bytes == 3 and c.get("k") == b"123"
+        c.get("k")                      # protected
+        c.set("k", b"7" * 8)            # update while protected
+        assert c.get("k") == b"7" * 8 and c.bytes == 8
+
+    def test_pop_removes_without_evict_callback(self):
+        fired = []
+        c = SegmentedLRU(1 << 10, on_evict=lambda *a: fired.append(a))
+        c.set("k", b"v")
+        assert c.pop("k") == b"v"
+        assert c.pop("k") is None
+        assert not fired
+
+
+class TestDiskCacheTier:
+    def test_round_trip_and_reload(self, tmp_path):
+        t = DiskCacheTier(str(tmp_path / "c"), 1 << 20)
+        t.set("v3/n/1a", b"needle bytes")
+        assert t.get("v3/n/1a") == b"needle bytes"
+        t2 = DiskCacheTier(str(tmp_path / "c"), 1 << 20)
+        assert t2.get("v3/n/1a") == b"needle bytes"
+
+    def test_budget_eviction(self, tmp_path):
+        t = DiskCacheTier(str(tmp_path / "c"), 10)
+        t.set("v1/n/1", b"123456")
+        t.set("v1/n/2", b"7890123")
+        assert t.get("v1/n/1") is None
+        assert t.get("v1/n/2") == b"7890123"
+        assert t.evictions == 1
+
+    def test_drop_volume_only_hits_that_volume(self, tmp_path):
+        t = DiskCacheTier(str(tmp_path / "c"), 1 << 20)
+        t.set("v1/n/1", b"a")
+        t.set("v1/s/2/0/100", b"b")
+        t.set("v2/n/1", b"c")
+        assert t.drop_volume(1) == 2
+        assert t.get("v1/n/1") is None
+        assert t.get("v2/n/1") == b"c"
+
+
+class TestTieredReadCache:
+    def test_needle_and_span_keys(self):
+        assert TieredReadCache.needle_key(3, 0x1a) == "v3/n/1a"
+        assert TieredReadCache.span_key(3, 7, 4096, 256) == "v3/s/7/4096/256"
+
+    def test_get_set_hit_miss_accounting(self):
+        c = TieredReadCache(1 << 20)
+        k = c.needle_key(1, 5)
+        assert c.get(k) is None
+        c.set(k, b"blob")
+        assert c.get(k) == b"blob"
+        assert c.hits == 1 and c.misses == 1
+
+    def test_invalidate_needle_keeps_spans_and_other_needles(self):
+        c = TieredReadCache(1 << 20)
+        c.set(c.needle_key(1, 5), b"n5")
+        c.set(c.needle_key(1, 6), b"n6")
+        c.set(c.span_key(1, 2, 0, 100), b"s" * 100)
+        dropped = c.invalidate(1, 5, reason="delete")
+        assert dropped == 1  # only the needle: a delete tombstones
+        assert c.get(c.needle_key(1, 5)) is None
+        assert c.get(c.needle_key(1, 6)) == b"n6"  # other needles stay
+        # shard bytes are untouched by a delete: spans stay valid
+        assert c.get(c.span_key(1, 2, 0, 100)) == b"s" * 100
+
+    def test_invalidate_volume_is_scoped(self):
+        c = TieredReadCache(1 << 20)
+        c.set(c.needle_key(1, 5), b"a")
+        c.set(c.span_key(1, 0, 0, 10), b"b")
+        c.set(c.needle_key(2, 5), b"c")
+        assert c.invalidate_volume(1, "rebuild") == 2
+        assert c.get(c.needle_key(2, 5)) == b"c"
+        assert c.invalidations == 2
+
+    def test_invalidate_reaches_disk_tier(self, tmp_path):
+        c = TieredReadCache(256, disk_dir=str(tmp_path / "d"))
+        big = b"x" * 200           # > mem max_item (256//8): disk only
+        c.set(c.needle_key(1, 9), big)
+        assert c.get(c.needle_key(1, 9)) == big
+        c.invalidate_volume(1)
+        assert c.get(c.needle_key(1, 9)) is None
+
+    def test_protected_eviction_spills_to_disk(self, tmp_path):
+        c = TieredReadCache(300, disk_dir=str(tmp_path / "d"))
+        k = c.needle_key(1, 1)
+        c.set(k, b"h" * 30)
+        assert c.get(k) is not None    # protected
+        for i in range(2, 40):         # pressure far past the budget
+            c.set(c.needle_key(1, i), b"c" * 30)
+        assert c.get(k) == b"h" * 30, "hot entry lost instead of demoted"
+
+    def test_single_flight_one_leader(self):
+        c = TieredReadCache(1 << 20)
+        key = c.needle_key(1, 1)
+        computes = []
+        barrier = threading.Barrier(8)
+
+        def reader():
+            barrier.wait()
+            v = c.get(key)
+            if v is None:
+                with c.single_flight(key) as leader:
+                    if not leader:
+                        v = c.get(key)
+                    if v is None:
+                        computes.append(1)
+                        c.set(key, b"computed")
+
+        ts = [threading.Thread(target=reader) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(computes) == 1, f"{len(computes)} reconstructions ran"
+        assert c.get(key) == b"computed"
+
+    def test_single_flight_follower_recovers_from_leader_error(self):
+        c = TieredReadCache(1 << 20)
+        key = c.needle_key(1, 2)
+        with pytest.raises(RuntimeError):
+            with c.single_flight(key) as leader:
+                assert leader
+                raise RuntimeError("leader failed")
+        # the key is released: the next entrant leads again
+        with c.single_flight(key) as leader:
+            assert leader
+
+    def test_generation_refuses_stale_set_after_invalidate(self):
+        """A reconstruction that began before an invalidation must not
+        re-insert its blob after it (delete/scrub-repair race)."""
+        c = TieredReadCache(1 << 20)
+        key = c.needle_key(1, 5)
+        gen = c.generation(key)        # snapshot, then "reconstruct"
+        c.invalidate(1, 5, reason="delete")
+        c.set(key, b"stale", gen=gen)  # refused: key fence moved
+        assert c.get(key) is None
+        gen2 = c.generation(key)
+        c.set(key, b"fresh", gen=gen2)
+        assert c.get(key) == b"fresh"
+        # a needle-level invalidation must NOT fence other keys
+        other = c.needle_key(1, 6)
+        g_other = c.generation(other)
+        c.invalidate(1, 5, reason="delete")
+        c.set(other, b"ok", gen=g_other)
+        assert c.get(other) == b"ok"
+        # a volume-level invalidation fences every key of the volume
+        g3 = c.generation(other)
+        c.invalidate_volume(1, "rebuild")
+        c.set(other, b"stale2", gen=g3)
+        assert c.get(other) is None
+
+    def test_invalidate_reaches_restart_resident_disk_entries(self,
+                                                              tmp_path):
+        """Disk files re-indexed at restart were never set() through
+        this instance — volume invalidation must still drop them."""
+        c1 = TieredReadCache(256, disk_dir=str(tmp_path / "d"))
+        big = b"x" * 200               # disk-only entry
+        c1.set(c1.needle_key(7, 1), big)
+        # "restart": a fresh cache over the same directory
+        c2 = TieredReadCache(256, disk_dir=str(tmp_path / "d"))
+        assert c2.get(c2.needle_key(7, 1)) == big  # warm from disk
+        c2.invalidate_volume(7, "scrub_repair")
+        assert c2.get(c2.needle_key(7, 1)) is None
+        # and a third instance must not resurrect it either
+        c3 = TieredReadCache(256, disk_dir=str(tmp_path / "d"))
+        assert c3.get(c3.needle_key(7, 1)) is None
+
+    def test_drop_evicts_single_key_from_all_tiers(self, tmp_path):
+        c = TieredReadCache(1 << 20, disk_dir=str(tmp_path / "d"))
+        k = c.needle_key(1, 1)
+        c.set(k, b"v")
+        c.disk.set(k, b"v")
+        c.drop(k)
+        assert c.get(k) is None
+
+    def test_stats_block(self, tmp_path):
+        c = TieredReadCache(1 << 20, disk_dir=str(tmp_path / "d"))
+        c.set(c.needle_key(1, 1), b"x")
+        st = c.stats()
+        assert st["enabled"] and st["mem_entries"] == 1
+        assert "disk_dir" in st and st["volumes"] == 1
