@@ -466,7 +466,164 @@ def degraded_read_sweep(batches=(1, 8, 64)) -> dict:
             "sweep": sweep}
 
 
+def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
+                          replications=("000", "010")) -> dict:
+    """--ingest mode: filer multi-chunk upload throughput.
+
+    The master and 2 volume servers (racks r0/r1) run as REAL CLI
+    subprocesses (the bench_profile.py pattern) — in-process servers
+    would share the ingest client's GIL and hide exactly the overlap
+    this sweep measures. The filer ingest path itself runs in-process
+    as the client under test; per (chunk count x replication) cell two
+    paths upload the same body straight through
+    FilerServer.upload_to_chunks:
+
+      serial     -ingest.parallelism 1, no lease cache — one master
+                 assign + one blocking volume upload per chunk (the
+                 pre-ISSUE-5 shape);
+      pipelined  -ingest.parallelism 8 + -assign.leaseCount 16 —
+                 chunk k+1 sliced while k-w..k upload concurrently,
+                 assigns amortized count=N.
+
+    Reported as uploads of the whole body per second (best-of-N,
+    paths alternated per the fleet-sweep methodology — single-shot
+    timings on shared VMs swing ±50%), plus master assign round trips
+    per body on each path.
+    """
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from seaweedfs_tpu.operation.assign_lease import LeaseCache
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    chunk_kb = int(os.environ.get("BENCH_INGEST_CHUNK_KB", "64"))
+    repeats = int(os.environ.get("BENCH_INGEST_REPEATS", "3"))
+    parallelism = int(os.environ.get("BENCH_INGEST_PARALLELISM", "8"))
+    lease_count = int(os.environ.get("BENCH_INGEST_LEASES", "16"))
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def spawn(*args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", *args],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=REPO_ROOT, env=env)
+
+    def wait_http(url, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2):
+                    return
+            except OSError:
+                time.sleep(0.2)
+        raise RuntimeError(f"server at {url} never came up")
+
+    rng = np.random.default_rng(29)
+    sweep = []
+    procs = []
+    with tempfile.TemporaryDirectory() as d:
+        mport = free_port()
+        master_url = f"127.0.0.1:{mport}"
+        try:
+            procs.append(spawn("master", "-port", str(mport),
+                               "-mdir", os.path.join(d, "m"),
+                               "-volumeSizeLimitMB", "256",
+                               "-pulseSeconds", "0.3"))
+            wait_http(f"http://{master_url}/cluster/status")
+            for i, rack in enumerate(("r0", "r1")):
+                vport = free_port()
+                procs.append(spawn(
+                    "volume", "-port", str(vport),
+                    "-dir", os.path.join(d, f"v{i}"), "-max", "200",
+                    "-rack", rack, "-mserver", master_url,
+                    "-pulseSeconds", "0.3"))
+                wait_http(f"http://127.0.0.1:{vport}/status")
+            time.sleep(1.0)   # first heartbeats register the nodes
+
+            fs = FilerServer(master_url=master_url, port=free_port(),
+                             chunk_size=chunk_kb << 10,
+                             ingest_parallelism=parallelism)
+
+            def run_one(n_chunks, replication, pipelined):
+                body = rng.integers(0, 256, n_chunks * (chunk_kb << 10),
+                                    dtype=np.uint8).tobytes()
+                if pipelined:
+                    fs.ingest_parallelism = parallelism
+                    fs.leases = LeaseCache(count=lease_count) \
+                        if lease_count > 1 else None
+                else:
+                    fs.ingest_parallelism = 1
+                    fs.leases = None
+                t0 = time.perf_counter()
+                chunks = fs.upload_to_chunks(body,
+                                             replication=replication)
+                dt = time.perf_counter() - t0
+                assert len(chunks) == n_chunks
+                assigns = fs.leases.assign_round_trips if fs.leases \
+                    else n_chunks
+                return dt, assigns
+
+            for replication in replications:
+                for n_chunks in chunk_counts:
+                    run_one(n_chunks, replication, False)  # warm vols
+                    serial_s, piped_s = [], []
+                    serial_assigns = piped_assigns = 0
+                    for _ in range(max(1, repeats)):  # alternate: load
+                        # spikes hit both paths
+                        dt, serial_assigns = run_one(
+                            n_chunks, replication, pipelined=False)
+                        serial_s.append(dt)
+                        dt, piped_assigns = run_one(
+                            n_chunks, replication, pipelined=True)
+                        piped_s.append(dt)
+                    mb = n_chunks * chunk_kb / 1024
+                    sweep.append({
+                        "chunks": n_chunks,
+                        "replication": replication,
+                        "serial_uploads_s": round(1 / min(serial_s), 2),
+                        "pipelined_uploads_s":
+                            round(1 / min(piped_s), 2),
+                        "serial_mb_s": round(mb / min(serial_s), 1),
+                        "pipelined_mb_s": round(mb / min(piped_s), 1),
+                        "speedup":
+                            round(min(serial_s) / min(piped_s), 3),
+                        "serial_assigns": serial_assigns,
+                        "pipelined_assigns": piped_assigns,
+                    })
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    headline = max((row["speedup"] for row in sweep
+                    if row["chunks"] == max(chunk_counts)),
+                   default=0.0)
+    return {"metric": "ingest_pipeline_sweep", "unit": "uploads/s",
+            "chunk_kb": chunk_kb, "parallelism": parallelism,
+            "lease_count": lease_count,
+            "value": headline, "sweep": sweep}
+
+
 def main() -> None:
+    if "--ingest" in sys.argv:
+        # ingest mode is host-pipeline only: filer write-path
+        # throughput, not the kernel headline
+        line = ingest_pipeline_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_INGEST.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
     if "--degraded" in sys.argv:
         # degraded mode is host-pipeline only: serving-path decode
         # throughput, not the kernel headline

@@ -78,12 +78,19 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
                                collection: str = "benchmark",
                                replication: str = "000",
                                do_read: bool = True,
+                               lease_count: int = 0,
                                out=None) -> dict:
     """Run the benchmark and return {write: Stats, read: Stats,
     write_seconds, read_seconds}.  Used by the CLI and by tests/
-    BASELINE measurements."""
+    BASELINE measurements. lease_count > 1 amortizes master assigns
+    through a fid LeaseCache shared by all writers (-assign.leaseCount,
+    reference benchmark.go's count=N batches)."""
     import sys
     out = out or sys.stdout
+    leases = None
+    if lease_count > 1:
+        from seaweedfs_tpu.operation.assign_lease import LeaseCache
+        leases = LeaseCache(count=lease_count)
     fids: List[str] = []
     fid_lock = threading.Lock()
     wstats = Stats()
@@ -105,7 +112,8 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
             try:
                 fid = operations.upload(
                     master, payload[:size], filename=f"bench{i}",
-                    collection=collection, replication=replication)
+                    collection=collection, replication=replication,
+                    leases=leases)
                 wstats.add(time.monotonic() - t0, size)
                 with fid_lock:
                     fids.append(fid)
@@ -181,9 +189,14 @@ def run_bench(args) -> int:
     p.add_argument("-collection", default="benchmark")
     p.add_argument("-replication", default="000")
     p.add_argument("-noread", dest="no_read", action="store_true")
+    p.add_argument("-assign.leaseCount", dest="lease_count", type=int,
+                   default=0,
+                   help="lease N fids per master assign (0 = one "
+                        "assign round trip per write)")
     opts = p.parse_args(args)
     run_benchmark_programmatic(
         opts.master, n=opts.n, concurrency=opts.concurrency,
         size=opts.size, collection=opts.collection,
-        replication=opts.replication, do_read=not opts.no_read)
+        replication=opts.replication, do_read=not opts.no_read,
+        lease_count=opts.lease_count)
     return 0

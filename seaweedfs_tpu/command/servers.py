@@ -177,6 +177,10 @@ def _volume_parser() -> argparse.ArgumentParser:
                    help="fuse concurrent degraded-read reconstructions "
                         "into batched RS decode dispatches (false = "
                         "per-interval in-place recovery)")
+    p.add_argument("-replicate.parallel", dest="replicate_parallel",
+                   type=int, default=8,
+                   help="replica POSTs issued concurrently per "
+                        "replicated write (1 = serial fan-out)")
     p.add_argument("-degraded.batchMs", dest="degraded_batch_ms",
                    type=float, default=2.0,
                    help="decode-fleet batch window in milliseconds: how "
@@ -229,7 +233,8 @@ def _build_volume(opts):
         cache_size_mb=opts.cache_size_mb,
         cache_dir=opts.cache_dir or None,
         degraded_fleet=opts.degraded_fleet,
-        degraded_batch_ms=opts.degraded_batch_ms)
+        degraded_batch_ms=opts.degraded_batch_ms,
+        replicate_parallel=opts.replicate_parallel)
 
 
 @command("volume", "start a volume server (data plane)")
@@ -262,6 +267,14 @@ def _filer_parser() -> argparse.ArgumentParser:
                    help="auto-chunking split size")
     p.add_argument("-encryptVolumeData", dest="cipher",
                    action="store_true")
+    p.add_argument("-ingest.parallelism", dest="ingest_parallelism",
+                   type=int, default=8,
+                   help="chunk uploads in flight per multi-chunk body "
+                        "(1 = fully serial ingest, no pool threads)")
+    p.add_argument("-assign.leaseCount", dest="assign_lease_count",
+                   type=int, default=0,
+                   help="lease N fids per master assign and hand them "
+                        "out locally (0 = one assign per chunk)")
     p.add_argument("-peers", default="",
                    help="comma-separated host:port of ALL filers in "
                         "this cluster (merged metadata view)")
@@ -287,7 +300,9 @@ def _build_filer(opts):
         replication=opts.replication,
         chunk_size=opts.max_mb << 20, cipher=opts.cipher,
         cache_dir=os.path.join(opts.dir, "cache"),
-        peers=peers)
+        peers=peers,
+        ingest_parallelism=opts.ingest_parallelism,
+        assign_lease_count=opts.assign_lease_count)
     # notification.toml: publish every metadata mutation to the first
     # enabled [notification.X] queue (reference filer.go
     # LoadConfiguration("notification"))

@@ -15,12 +15,17 @@ from typing import Dict, List, NamedTuple
 
 from seaweedfs_tpu.pb import master_pb2, master_stub, volume_server_pb2, volume_stub
 from seaweedfs_tpu.util import http_client
+from seaweedfs_tpu.util.fanout import FanOutPool
 
 
 import itertools
 
 _BOUNDARY_PREFIX = secrets.token_hex(12)
 _boundary_counter = itertools.count()
+
+# shared per-server fan-out for batch deletes; zero threads until the
+# first multi-server delete (fanout.py house rule)
+_delete_pool = FanOutPool(8, "delete-fanout")
 
 
 class Assignment(NamedTuple):
@@ -124,20 +129,44 @@ def upload_data(url_fid: str, data: bytes, filename: str = "",
     return out
 
 
+def _assign_or_lease(master_url: str, leases, replication: str,
+                     collection: str, ttl: str,
+                     data_center: str = "") -> Assignment:
+    """One fid — from a LeaseCache (operation/assign_lease.py) when the
+    caller holds one, via a direct master assign otherwise."""
+    if leases is not None:
+        return leases.acquire(master_url, collection=collection,
+                              replication=replication, ttl=ttl,
+                              data_center=data_center)
+    return assign(master_url, replication=replication,
+                  collection=collection, ttl=ttl, data_center=data_center)
+
+
 def upload(master_url: str, data: bytes, filename: str = "", mime: str = "",
            replication: str = "", collection: str = "", ttl: str = "",
-           data_center: str = "") -> str:
-    """Assign + upload; returns the fid."""
-    a = assign(master_url, replication=replication, collection=collection,
-               ttl=ttl, data_center=data_center)
-    upload_data(f"{a.url}/{a.fid}", data, filename=filename, mime=mime,
-                ttl=ttl)
+           data_center: str = "", leases=None) -> str:
+    """Assign + upload; returns the fid. A leased fid that fails at the
+    volume server is invalidated (dropping its volume's siblings) and
+    retried once on a fresh direct assign."""
+    a = _assign_or_lease(master_url, leases, replication, collection,
+                         ttl, data_center)
+    try:
+        upload_data(f"{a.url}/{a.fid}", data, filename=filename, mime=mime,
+                    ttl=ttl)
+    except (RuntimeError, OSError):
+        if leases is None:
+            raise
+        leases.invalidate(a.fid)
+        a = assign(master_url, replication=replication,
+                   collection=collection, ttl=ttl, data_center=data_center)
+        upload_data(f"{a.url}/{a.fid}", data, filename=filename, mime=mime,
+                    ttl=ttl)
     return a.fid
 
 
 def submit(master_url: str, data: bytes, filename: str = "",
            mime: str = "", replication: str = "", collection: str = "",
-           ttl: str = "", max_mb: int = 0) -> str:
+           ttl: str = "", max_mb: int = 0, leases=None) -> str:
     """Upload one file, splitting into chunk needles + a manifest when
     it exceeds max_mb (reference operation/submit.go:128-232). Returns
     the fid to GET — the manifest's fid for chunked uploads. On any
@@ -145,7 +174,7 @@ def submit(master_url: str, data: bytes, filename: str = "",
     if max_mb <= 0 or len(data) <= max_mb << 20:
         return upload(master_url, data, filename=filename, mime=mime,
                       replication=replication, collection=collection,
-                      ttl=ttl)
+                      ttl=ttl, leases=leases)
     from seaweedfs_tpu.operation.chunked_file import (ChunkInfo,
                                                       ChunkManifest)
     chunk_size = max_mb << 20
@@ -153,15 +182,15 @@ def submit(master_url: str, data: bytes, filename: str = "",
     try:
         for i, off in enumerate(range(0, len(data), chunk_size)):
             piece = data[off:off + chunk_size]
-            a = assign(master_url, replication=replication,
-                       collection=collection, ttl=ttl)
+            a = _assign_or_lease(master_url, leases, replication,
+                                 collection, ttl)
             upload_data(f"{a.url}/{a.fid}", piece,
                         filename=f"{filename}-{i + 1}" if filename else "",
                         ttl=ttl)
             cm.chunks.append(ChunkInfo(fid=a.fid, offset=off,
                                        size=len(piece)))
-        a = assign(master_url, replication=replication,
-                   collection=collection, ttl=ttl)
+        a = _assign_or_lease(master_url, leases, replication,
+                             collection, ttl)
         upload_data(f"{a.url}/{a.fid}", cm.marshal(), filename=filename,
                     mime="application/json", ttl=ttl,
                     is_chunk_manifest=True)
@@ -215,8 +244,11 @@ def delete_file(master_url: str, fid: str, timeout: float = 30.0) -> None:
 
 
 def delete_files(master_url: str, fids: List[str]) -> List[dict]:
-    """Batch delete, grouped by volume server
-    (reference operation/delete_content.go)."""
+    """Batch delete, grouped by volume server and fanned out
+    CONCURRENTLY — the per-server BatchDelete RPCs ride the shared
+    fan-out pool instead of walking servers one blocking round trip at
+    a time (reference operation/delete_content.go fans out with
+    goroutines)."""
     from seaweedfs_tpu.operation.file_id import parse_fid
     by_vid: Dict[int, List[str]] = {}
     results = []
@@ -237,10 +269,24 @@ def delete_files(master_url: str, fids: List[str]) -> List[dict]:
                            for f in group)
             continue
         by_server.setdefault(urls[0], []).extend(group)
-    for url, group in by_server.items():
+
+    def delete_on(url, group):
         resp = volume_stub(url).BatchDelete(
             volume_server_pb2.BatchDeleteRequest(file_ids=group))
-        for r in resp.results:
-            results.append({"fid": r.file_id, "status": r.status,
-                            "error": r.error, "size": r.size})
+        return [{"fid": r.file_id, "status": r.status,
+                 "error": r.error, "size": r.size}
+                for r in resp.results]
+
+    servers = list(by_server.items())
+    outcomes = _delete_pool.run(
+        [lambda u=u, g=g: delete_on(u, g) for u, g in servers])
+    first_exc = None
+    for (_url, _group), (server_results, exc) in zip(servers, outcomes):
+        if exc is not None:  # drain every server, then surface the first
+            if first_exc is None:
+                first_exc = exc
+            continue
+        results.extend(server_results)
+    if first_exc is not None:
+        raise first_exc
     return results

@@ -167,7 +167,9 @@ class FilerServer:
                  cipher: bool = False,
                  cache_dir: Optional[str] = None,
                  peers: Optional[List[str]] = None,
-                 store_options: Optional[dict] = None):
+                 store_options: Optional[dict] = None,
+                 ingest_parallelism: int = 8,
+                 assign_lease_count: int = 0):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -175,6 +177,24 @@ class FilerServer:
         self.replication = replication
         self.chunk_size = chunk_size
         self.cipher = cipher
+        # ingest pipeline (-ingest.parallelism): chunk k+1 is sliced /
+        # read off the socket while chunks k-w..k upload on this shared
+        # pool. Constructing the pool spawns NOTHING; threads appear on
+        # the first multi-chunk body (house rule, gated by
+        # test_perf_gates.test_ingest_pipeline_disabled_overhead).
+        self.ingest_parallelism = max(1, ingest_parallelism)
+        from seaweedfs_tpu.stats.metrics import IngestPipelineOccupancyGauge
+        from seaweedfs_tpu.util.fanout import FanOutPool
+        self._ingest_pool = FanOutPool(
+            self.ingest_parallelism, f"ingest-{port}",
+            inflight_gauge=IngestPipelineOccupancyGauge)
+        # fid lease cache (-assign.leaseCount): absent — not merely
+        # empty — unless sized, so the disabled assign path is one
+        # None check
+        self.leases = None
+        if assign_lease_count > 1:
+            from seaweedfs_tpu.operation.assign_lease import LeaseCache
+            self.leases = LeaseCache(count=assign_lease_count)
         backend = make_filer_store(store, meta_dir, store_options)
         self.filer = Filer(backend,
                            log_dir=f"{meta_dir}/logs" if meta_dir else None)
@@ -305,6 +325,13 @@ class FilerServer:
 
     def _assign(self, collection: str = "", replication: str = "",
                 ttl_sec: int = 0, data_center: str = ""):
+        if self.leases is not None:
+            return self.leases.acquire(
+                self.master_url,
+                collection=collection or self.collection,
+                replication=replication or self.replication,
+                ttl=ttl_string(ttl_sec),
+                data_center=data_center)
         return operations.assign(
             self.master_url,
             collection=collection or self.collection,
@@ -312,29 +339,143 @@ class FilerServer:
             ttl=ttl_string(ttl_sec),
             data_center=data_center)
 
+    def _upload_one(self, off: int, piece: bytes, collection: str,
+                    replication: str, ttl_sec: int, mime: str,
+                    fsync: bool) -> filer_pb2.FileChunk:
+        """Assign + upload ONE chunk; the unit both the serial and the
+        pipelined paths run. A leased fid that fails at the volume
+        server invalidates its whole volume's leases and retries once
+        on a fresh direct assign (the lease went stale, not the data)."""
+        from seaweedfs_tpu.stats import trace
+        cipher_key = b""
+        stored = piece
+        if self.cipher:
+            stored, cipher_key = encrypt(piece)
+        sp = trace.span("ingest.chunk_upload", off=off, size=len(piece)) \
+            if trace.is_enabled() else trace.NOOP
+        with sp:
+            a = self._assign(collection, replication, ttl_sec)
+            try:
+                resp = operations.upload_data(
+                    f"{a.url}/{a.fid}", stored, mime=mime, fsync=fsync)
+            except (RuntimeError, OSError):
+                if self.leases is None:
+                    raise
+                self.leases.invalidate(a.fid)
+                a = operations.assign(
+                    self.master_url,
+                    collection=collection or self.collection,
+                    replication=replication or self.replication,
+                    ttl=ttl_string(ttl_sec))
+                resp = operations.upload_data(
+                    f"{a.url}/{a.fid}", stored, mime=mime, fsync=fsync)
+        return filer_pb2.FileChunk(
+            file_id=a.fid, offset=off, size=len(piece),
+            mtime=time.time_ns(), e_tag=resp.get("eTag", ""),
+            cipher_key=cipher_key)
+
+    def _upload_pieces(self, pieces, n_pieces: int, collection: str,
+                       replication: str, ttl_sec: int, mime: str,
+                       fsync: bool) -> List[filer_pb2.FileChunk]:
+        """Run (offset, bytes) pieces through assign+upload.
+
+        Single piece (or -ingest.parallelism 1): fully serial, zero
+        threads — the disabled-overhead invariant. Multi-chunk: a
+        bounded producer/consumer pipeline. The producer (this thread)
+        slices piece k+1 — or reads it off the socket in the streaming
+        path — while up to `window` older pieces upload on the shared
+        pool. Results assemble in offset order; the first failure
+        latches, stops the producer (cancel-on-first-failure: the tail
+        is never submitted) and surfaces after every in-flight upload
+        drains (reference uploadReaderToChunks' errgroup shape).
+        """
+        if n_pieces <= 1 or self.ingest_parallelism <= 1:
+            return [self._upload_one(off, piece, collection, replication,
+                                     ttl_sec, mime, fsync)
+                    for off, piece in pieces]
+        from collections import deque
+
+        from seaweedfs_tpu.stats import trace
+        from seaweedfs_tpu.stats.metrics import \
+            IngestPipelineChunksHistogram
+        IngestPipelineChunksHistogram.observe(n_pieces)
+        window = self.ingest_parallelism
+        pending: deque = deque()    # futures in submission order
+        chunks: List[filer_pb2.FileChunk] = []
+        first_err: Optional[BaseException] = None
+
+        def drain_one():
+            nonlocal first_err
+            result, exc = pending.popleft().wait()
+            if exc is not None:
+                if first_err is None:
+                    first_err = exc
+            else:
+                chunks.append(result)
+
+        sp = trace.span("ingest.pipeline", chunks=n_pieces) \
+            if trace.is_enabled() else trace.NOOP
+        with sp:
+            try:
+                for off, piece in pieces:
+                    if first_err is not None:
+                        break
+                    pending.append(self._ingest_pool.submit(
+                        self._upload_one, off, piece, collection,
+                        replication, ttl_sec, mime, fsync))
+                    while len(pending) >= window:
+                        drain_one()
+            except Exception as e:
+                # producer failure (e.g. the streaming reader's short
+                # read): latch it like a consumer failure so the drain
+                # below still runs — in-flight uploads must never be
+                # orphaned on the shared pool
+                if first_err is None:
+                    first_err = e
+            while pending:
+                drain_one()
+        if first_err is not None:
+            raise first_err
+        chunks.sort(key=lambda c: c.offset)
+        return chunks
+
     def upload_to_chunks(self, data: bytes, collection: str = "",
                          replication: str = "", ttl_sec: int = 0,
                          mime: str = "",
                          fsync: bool = False) -> List[filer_pb2.FileChunk]:
         """Split `data` into chunkSize pieces, assign+upload each
         (reference uploadReaderToChunks)."""
-        chunks: List[filer_pb2.FileChunk] = []
-        for off in range(0, max(len(data), 1), self.chunk_size):
-            piece = data[off:off + self.chunk_size]
-            cipher_key = b""
-            stored = piece
-            if self.cipher:
-                stored, cipher_key = encrypt(piece)
-            a = self._assign(collection, replication, ttl_sec)
-            resp = operations.upload_data(
-                f"{a.url}/{a.fid}", stored, mime=mime, fsync=fsync)
-            chunks.append(filer_pb2.FileChunk(
-                file_id=a.fid, offset=off, size=len(piece),
-                mtime=time.time_ns(), e_tag=resp.get("eTag", ""),
-                cipher_key=cipher_key))
-            if not piece:  # empty file: single empty chunk, stop
-                break
-        return chunks
+        size = len(data)
+        n_pieces = max(1, -(-size // self.chunk_size))
+        pieces = ((off, data[off:off + self.chunk_size])
+                  for off in range(0, max(size, 1), self.chunk_size))
+        return self._upload_pieces(pieces, n_pieces, collection,
+                                   replication, ttl_sec, mime, fsync)
+
+    def upload_stream_to_chunks(self, reader, size: int,
+                                collection: str = "",
+                                replication: str = "", ttl_sec: int = 0,
+                                mime: str = "", fsync: bool = False
+                                ) -> List[filer_pb2.FileChunk]:
+        """Like upload_to_chunks but the body arrives through `reader`
+        (the request socket): chunk k+1 is read off the wire while
+        earlier chunks upload — the whole body is never resident."""
+        n_pieces = max(1, -(-size // self.chunk_size))
+
+        def pieces():
+            off = 0
+            while off < size or off == 0:
+                want = min(self.chunk_size, size - off)
+                piece = reader.read(want) if want else b""
+                if want and len(piece) != want:
+                    raise OSError(
+                        f"short read: body ended {off + len(piece)}"
+                        f"/{size}")
+                yield off, piece
+                off += max(len(piece), 1)
+
+        return self._upload_pieces(pieces(), n_pieces, collection,
+                                   replication, ttl_sec, mime, fsync)
 
     def save_manifest_blob(self, data: bytes) -> filer_pb2.FileChunk:
         a = self._assign()
@@ -770,8 +911,16 @@ def _make_http_handler(fs: FilerServer):
 
         def do_POST(self):
             path, params = self._path_and_params()
-            body = self._body()
             ctype = self.headers.get("Content-Type") or ""
+            clen = int(self.headers.get("Content-Length") or 0)
+            # multi-chunk non-multipart bodies stream off the socket
+            # chunk by chunk (read overlaps upload; the body is never
+            # resident). Any reply sent before the body is drained must
+            # drop the connection — leftover body bytes would desync
+            # the next keep-alive request.
+            streaming = (clen > fs.chunk_size
+                         and not ctype.startswith("multipart/form-data"))
+            body = b"" if streaming else self._body()
             filename, mime, data = "", ctype, body
             if ctype.startswith("multipart/form-data"):
                 from seaweedfs_tpu.server.volume import parse_multipart
@@ -786,6 +935,7 @@ def _make_http_handler(fs: FilerServer):
                 path = path + filename if filename else path[:-1]
             directory, name = split_path(path)
             if not name:
+                self.close_connection = streaming or self.close_connection
                 self._json({"error": "cannot write to /"}, code=400)
                 return
             collection = params.get("collection", [""])[0]
@@ -801,14 +951,27 @@ def _make_http_handler(fs: FilerServer):
             try:
                 ttl_sec = _parse_ttl_seconds(ttl_param)
             except ValueError:
+                self.close_connection = streaming or self.close_connection
                 self._json({"error": "bad ttl"}, code=400)
                 return
             try:
-                chunks = fs.upload_to_chunks(
-                    data, collection=collection, replication=replication,
-                    ttl_sec=ttl_sec, mime=mime, fsync=fsync)
+                if streaming:
+                    chunks = fs.upload_stream_to_chunks(
+                        self.rfile, clen, collection=collection,
+                        replication=replication, ttl_sec=ttl_sec,
+                        mime=mime, fsync=fsync)
+                    data_size = clen
+                else:
+                    chunks = fs.upload_to_chunks(
+                        data, collection=collection,
+                        replication=replication, ttl_sec=ttl_sec,
+                        mime=mime, fsync=fsync)
+                    data_size = len(data)
                 chunks = maybe_manifestize(fs.save_manifest_blob, chunks)
             except (RuntimeError, OSError) as e:
+                # mid-stream failure: part of the body may still sit
+                # unread on the socket
+                self.close_connection = streaming or self.close_connection
                 self._json({"error": str(e)}, code=500)
                 return
             entry = new_entry(
@@ -823,7 +986,7 @@ def _make_http_handler(fs: FilerServer):
                 self._json({"error": str(e)}, code=500)
                 return
             fs._maybe_reload_conf(join_path(directory, name))
-            self._json({"name": name, "size": len(data)}, code=201,
+            self._json({"name": name, "size": data_size}, code=201,
                        headers={"ETag": filechunks.etag_of_chunks(chunks)})
 
         do_PUT = do_POST

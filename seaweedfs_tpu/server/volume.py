@@ -58,6 +58,11 @@ COPY_CHUNK = 1 << 20
 EC_REFRESH_SPARSE_S = 11.0
 EC_REFRESH_PARTIAL_S = 7 * 60.0
 EC_REFRESH_FULL_S = 37 * 60.0
+# Replica-location freshness: replica sets move on volume.fix.replication
+# / rebalance, so the window stays short; any replica POST failure
+# forgets the vid immediately (same invalidate-on-failure discipline as
+# _ec_locations)
+REPLICA_REFRESH_S = 30.0
 
 
 class VolumeServer:
@@ -74,7 +79,8 @@ class VolumeServer:
                  cache_size_mb: int = 0,
                  cache_dir: Optional[str] = None,
                  degraded_fleet: bool = True,
-                 degraded_batch_ms: float = 2.0):
+                 degraded_batch_ms: float = 2.0,
+                 replicate_parallel: int = 8):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -127,6 +133,16 @@ class VolumeServer:
         self.volume_size_limit = 30 << 30
         self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
         self._ec_locations: Dict[int, Tuple[float, Dict[int, List[str]]]] = {}
+        # replica fan-out (-replicate.parallel): all replica POSTs for
+        # one write go out concurrently on this shared pool. The pool
+        # spawns no threads until the first multi-replica fan-out
+        # (single-replica placements run inline), and replica URLs are
+        # cached per vid instead of asking the master on EVERY
+        # replicated write
+        from seaweedfs_tpu.util.fanout import FanOutPool
+        self._replicate_pool = FanOutPool(
+            max(1, replicate_parallel), f"replicate-{port}")
+        self._replica_urls: Dict[int, Tuple[float, List[str]]] = {}
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -986,22 +1002,75 @@ class VolumeServer:
     # -- replication -----------------------------------------------------------
 
     def _other_replicas(self, vid: int) -> List[str]:
+        """Replica urls for vid, cached per REPLICA_REFRESH_S — the
+        pre-cache shape asked the master on EVERY replicated write."""
+        now = time.monotonic()
+        cached = self._replica_urls.get(vid)
+        if cached is not None and now - cached[0] < REPLICA_REFRESH_S:
+            return cached[1]
         try:
             resp = master_stub(self.current_master).LookupVolume(
                 master_pb2.LookupVolumeRequest(volume_ids=[str(vid)]))
         except grpc.RpcError:
-            return []
+            # master unreachable: serve stale locations if any — a
+            # replica POST to a moved node fails and forgets the vid
+            return cached[1] if cached is not None else []
         urls = []
         for vl in resp.volume_id_locations:
             for loc in vl.locations:
                 if loc.url != self.url:
                     urls.append(loc.url)
+        if not urls:
+            # never CACHE an empty view: a replica mid-restart is
+            # missing from the master for a beat, and banking that
+            # would ack 30s of unreplicated writes instead of one
+            self._replica_urls.pop(vid, None)
+            return urls
+        self._replica_urls[vid] = (now, urls)
         return urls
+
+    def _forget_replicas(self, vid: int) -> None:
+        self._replica_urls.pop(vid, None)
+
+    def _fan_out_replicas(self, vid: int, urls: List[str], op: str,
+                          post_one) -> None:
+        """Issue `post_one(url)` for every replica concurrently on the
+        shared pool (reference topology/store_replicate.go fans these
+        out with goroutines). Every POST runs to completion — an early
+        failure never leaves a sibling's in-flight socket dangling to
+        poison the keep-alive pool — then the FIRST error fails the
+        write and forgets the vid's cached locations."""
+        from seaweedfs_tpu.stats import trace
+        from seaweedfs_tpu.stats.metrics import \
+            IngestReplicaFanoutSecondsHistogram
+        sp = trace.span("ingest.replicate", vid=vid, op=op,
+                        replicas=len(urls)) \
+            if trace.is_enabled() else trace.NOOP
+        t0 = time.perf_counter()
+        with sp:
+            outcomes = self._replicate_pool.run(
+                [lambda u=u: post_one(u) for u in urls])
+        IngestReplicaFanoutSecondsHistogram.labels(op).observe(
+            time.perf_counter() - t0)
+        first_err = None
+        for url, (resp, exc) in zip(urls, outcomes):
+            if exc is not None:
+                err = f"{op} to {url} failed: {exc}"
+            elif resp.status >= 300:
+                err = f"{op} to {url} failed: {resp.status}"
+            else:
+                continue
+            if first_err is None:
+                first_err = err
+        if first_err is not None:
+            self._forget_replicas(vid)
+            raise NeedleError(first_err)
 
     def replicated_write(self, vid: int, n: Needle,
                          fsync: bool = False) -> int:
         """Write locally then fan out the serialized needle to every
-        other replica (reference topology/store_replicate.go:21-94).
+        other replica CONCURRENTLY (reference
+        topology/store_replicate.go:21-94 + its goroutine fan-out).
 
         Like the reference, a volume whose replica placement says one
         copy never consults the master for replica locations — the
@@ -1014,16 +1083,19 @@ class VolumeServer:
         self._invalidate_needle_cache(vid, n.id, "overwrite")
         if v is not None and v.replica_placement.copy_count <= 1:
             return size
+        urls = self._other_replicas(vid)
+        if not urls:
+            return size
         blob = n.to_bytes()
-        for url in self._other_replicas(vid):
-            resp = http_client.request(
+
+        def post_one(url):
+            return http_client.request(
                 "POST", f"{url}/admin/replicate?volume={vid}",
                 body=blob,
                 headers={"Content-Type": "application/octet-stream"},
                 timeout=30)
-            if resp.status >= 300:
-                raise NeedleError(
-                    f"replicate to {url} failed: {resp.status}")
+
+        self._fan_out_replicas(vid, urls, "replicate", post_one)
         return size
 
     def replicated_delete(self, vid: int, n: Needle) -> int:
@@ -1031,15 +1103,18 @@ class VolumeServer:
         v = self.store.find_volume(vid)
         if v is not None and v.replica_placement.copy_count <= 1:
             return size
-        for url in self._other_replicas(vid):
-            resp = http_client.request(
+        urls = self._other_replicas(vid)
+        if not urls:
+            return size
+
+        def post_one(url):
+            return http_client.request(
                 "POST",
                 f"{url}/admin/replicate_delete"
                 f"?volume={vid}&key={n.id:x}&cookie={n.cookie:08x}",
                 timeout=30)
-            if resp.status >= 300:
-                raise NeedleError(
-                    f"replicate delete to {url} failed: {resp.status}")
+
+        self._fan_out_replicas(vid, urls, "replicate_delete", post_one)
         return size
 
 

@@ -342,6 +342,47 @@ CacheInvalidateCounter = REGISTRY.counter(
 CacheBytesGauge = REGISTRY.gauge(
     "SeaweedFS_cache_bytes", "bytes resident per cache tier", ("tier",))
 
+# Ingest-pipeline families (operation/assign_lease.py, server/filer.py,
+# server/volume.py): the write path's ledger — how well assigns
+# amortize, how full the chunk-upload pipeline runs, and what replica
+# fan-outs cost.
+IngestLeaseDepthGauge = REGISTRY.gauge(
+    "SeaweedFS_ingest_lease_pool_depth",
+    "leased fids banked and ready to hand out without a master trip")
+IngestLeaseAssignsCounter = REGISTRY.counter(
+    "SeaweedFS_ingest_lease_assigns_total",
+    "count=N master assign round trips made by the lease cache")
+IngestLeaseServedCounter = REGISTRY.counter(
+    "SeaweedFS_ingest_lease_served_total",
+    "fids served from the lease pool (master round trip avoided)")
+IngestLeaseDiscardsCounter = REGISTRY.counter(
+    "SeaweedFS_ingest_lease_discards_total",
+    "banked leases dropped before use", ("reason",))
+IngestPipelineChunksHistogram = REGISTRY.histogram(
+    "SeaweedFS_ingest_pipeline_batch_chunks",
+    "chunks per pipelined multi-chunk upload",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+IngestPipelineOccupancyGauge = REGISTRY.gauge(
+    "SeaweedFS_ingest_pipeline_occupancy",
+    "chunk uploads in flight on the filer's ingest pool")
+IngestReplicaFanoutSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_ingest_replica_fanout_seconds",
+    "wall time of one concurrent replica fan-out", ("op",))
+
+# Data-plane connection-pool families (util/http_client.py): how many
+# keep-alive sockets sit banked per process and how often a pooled
+# socket turned out stale at first use (the idle-close race).
+HttpPoolIdleGauge = REGISTRY.gauge(
+    "SeaweedFS_http_pool_idle_connections",
+    "pooled keep-alive connections currently idle")
+HttpPoolStaleRetryCounter = REGISTRY.counter(
+    "SeaweedFS_http_pool_stale_retries_total",
+    "requests replayed on a fresh connection after a pooled one "
+    "proved stale")
+HttpPoolReapedCounter = REGISTRY.counter(
+    "SeaweedFS_http_pool_reaped_total",
+    "pooled connections closed for exceeding the idle age cap")
+
 
 # -- shared request instrumentation -------------------------------------------
 #

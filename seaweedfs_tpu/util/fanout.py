@@ -1,0 +1,112 @@
+"""Shared lazy fan-out pool for the ingest and delete planes.
+
+The write path has three places that used to walk peers one blocking
+round trip at a time — the filer's per-chunk uploads, the volume
+server's replica POSTs, and delete_files' per-server BatchDelete — and
+the reference fans each of them out with goroutines
+(topology/store_replicate.go, operation/delete_content.go). Python has
+no free goroutines, so this module is the shared substitute: a bounded
+worker pool that costs NOTHING until the first task.
+
+Cost discipline (the fleet/cache/scrub house rule, gated by
+tests/test_perf_gates.py::test_ingest_pipeline_disabled_overhead):
+constructing a FanOutPool allocates a queue and a lock — no threads.
+Workers spawn one-per-submit up to the cap on the first tasks and then
+persist (daemon), so a server that never sees a multi-chunk body or a
+replicated write never grows an ingest thread.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+
+class Future:
+    """Result slot for one submitted task: wait() -> (result, exc)."""
+
+    __slots__ = ("_ev", "result", "exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.result: Any = None
+        self.exc: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None
+             ) -> Tuple[Any, Optional[BaseException]]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("fan-out task still running")
+        return self.result, self.exc
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+
+class FanOutPool:
+    """Bounded daemon-worker pool; zero threads until first submit().
+
+    Tasks must never block on THIS pool's own futures (a task that
+    submits to its own saturated pool and waits can deadlock) — the
+    ingest callers all bottom out in plain socket/gRPC calls, which is
+    the contract.
+    """
+
+    def __init__(self, size: int = 8, name: str = "fanout",
+                 inflight_gauge=None):
+        self.size = max(1, int(size))
+        self.name = name
+        # tasks submitted but not finished; optional gauge mirrors it
+        # (SeaweedFS_ingest_pipeline_occupancy on the filer's pool)
+        self._inflight_gauge = inflight_gauge
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+
+    def thread_count(self) -> int:
+        return len(self._threads)
+
+    def _maybe_spawn(self) -> None:
+        with self._lock:
+            if len(self._threads) >= self.size:
+                return
+            t = threading.Thread(
+                target=self._worker, daemon=True,
+                name=f"{self.name}-{len(self._threads)}")
+            self._threads.append(t)
+        t.start()
+
+    def _worker(self) -> None:
+        while True:
+            fut, fn, args = self._q.get()
+            try:
+                fut.result = fn(*args)
+            except BaseException as e:  # noqa: BLE001 - latched, not lost
+                fut.exc = e
+            finally:
+                if self._inflight_gauge is not None:
+                    self._inflight_gauge.dec()
+                fut._ev.set()
+
+    def submit(self, fn: Callable, *args) -> Future:
+        fut = Future()
+        if self._inflight_gauge is not None:
+            self._inflight_gauge.inc()
+        self._maybe_spawn()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def run(self, fns: Sequence[Callable]
+            ) -> List[Tuple[Any, Optional[BaseException]]]:
+        """Run all thunks concurrently; ordered (result, exc) pairs.
+
+        Always drains every task — an early failure never leaves a
+        sibling's socket dangling half-read in a shared pool.
+        """
+        if len(fns) == 1:  # no thread hop for the degenerate fan-out
+            try:
+                return [(fns[0](), None)]
+            except BaseException as e:  # noqa: BLE001
+                return [(None, e)]
+        futs = [self.submit(fn) for fn in fns]
+        return [f.wait() for f in futs]

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
@@ -32,11 +33,33 @@ from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
 _pool_lock = threading.Lock()
 _pool: Dict[str, List["_Conn"]] = {}
 _MAX_IDLE_PER_HOST = 32
+# Idle-age cap: a pooled socket untouched this long is closed instead
+# of reused. Long-idle sockets are the ones the server side reaps
+# first, so under bursty load they surface as stale-retry churn (a
+# replayed request per reused-dead socket); reaping happens
+# opportunistically on pool get/put — no reaper thread, per the
+# zero-threads-until-used house rule.
+_IDLE_MAX_S = 60.0
 _MAX_LINE = 65536
 
 
+def _idle_count() -> int:
+    with _pool_lock:
+        return sum(len(c) for c in _pool.values())
+
+
+def _export_pool_gauge() -> None:
+    # collection-time callable: the gauge keeps moving without a write
+    # per pool mutation
+    from seaweedfs_tpu.stats.metrics import HttpPoolIdleGauge
+    HttpPoolIdleGauge.set_function(_idle_count)
+
+
+_export_pool_gauge()
+
+
 class _Conn:
-    __slots__ = ("netloc", "sock", "rfile")
+    __slots__ = ("netloc", "sock", "rfile", "last_used")
 
     def __init__(self, netloc: str, timeout: float):
         self.netloc = netloc
@@ -54,6 +77,7 @@ class _Conn:
                                              timeout=timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb", buffering=65536)
+        self.last_used = time.monotonic()
 
     def close(self) -> None:
         try:
@@ -67,23 +91,51 @@ class _Conn:
 
 
 def _get_conn(netloc: str, timeout: float) -> Tuple["_Conn", bool]:
-    """Returns (conn, reused)."""
+    """Returns (conn, reused). Conns past the idle-age cap are closed,
+    never handed out — they are the stale-retry churn under bursty
+    load."""
+    expired = []
+    conn = None
+    cutoff = time.monotonic() - _IDLE_MAX_S
     with _pool_lock:
         conns = _pool.get(netloc)
-        if conns:
-            conn = conns.pop()
-            conn.sock.settimeout(timeout)
-            return conn, True
+        while conns:
+            cand = conns.pop()
+            if cand.last_used >= cutoff:
+                conn = cand
+                break
+            expired.append(cand)
+    _reap(expired)
+    if conn is not None:
+        conn.sock.settimeout(timeout)
+        return conn, True
     return _Conn(netloc, timeout), False
 
 
 def _put_conn(conn: "_Conn") -> None:
+    conn.last_used = time.monotonic()
+    cutoff = conn.last_used - _IDLE_MAX_S
+    expired = []
     with _pool_lock:
         conns = _pool.setdefault(conn.netloc, [])
+        # oldest sit at the front (append order); shed them first
+        while conns and conns[0].last_used < cutoff:
+            expired.append(conns.pop(0))
         if len(conns) < _MAX_IDLE_PER_HOST:
             conns.append(conn)
-            return
-    conn.close()
+            conn = None
+    _reap(expired)
+    if conn is not None:
+        conn.close()
+
+
+def _reap(expired) -> None:
+    if not expired:
+        return
+    from seaweedfs_tpu.stats.metrics import HttpPoolReapedCounter
+    HttpPoolReapedCounter.inc(len(expired))
+    for c in expired:
+        c.close()
 
 
 def close_all() -> None:
@@ -135,6 +187,9 @@ def request(method: str, url: str, body: Optional[bytes] = None,
             conn.close()
             if not (reused and e.retryable) or attempt == 1:
                 raise
+            from seaweedfs_tpu.stats.metrics import \
+                HttpPoolStaleRetryCounter
+            HttpPoolStaleRetryCounter.inc()
             reuse_ok = False
             continue
         except OSError:

@@ -43,7 +43,8 @@ class Cluster:
                  ec_encoder: str = "numpy",
                  with_filer: bool = False,
                  filer_kwargs: Optional[dict] = None,
-                 volume_kwargs: Optional[dict] = None):
+                 volume_kwargs: Optional[dict] = None,
+                 racks: Optional[List[str]] = None):
         self.master = MasterServer(
             port=free_port_pair(),
             meta_dir=str(tmp_path / "master"),
@@ -70,6 +71,7 @@ class Cluster:
                     port=free_port_pair(),
                     max_volume_counts=[volumes_per_server],
                     pulse_seconds=pulse_seconds, ec_encoder=ec_encoder,
+                    rack=racks[i] if racks else "",
                     **(volume_kwargs or {}))
                 vs.start()
                 self.volume_servers.append(vs)

@@ -7,6 +7,7 @@ all in-process on loopback (house pattern, SURVEY.md §4).
 
 import json
 import os
+import time
 import urllib.error
 
 import pytest
@@ -800,5 +801,77 @@ def test_scrub_detects_and_repairs_corruption_end_to_end(tmp_path):
         # status page carries the scrub ledger
         with c.http(f"{vs.url}/status") as r:
             assert json.load(r)["Scrub"]["corruptions_found"] >= 2
+    finally:
+        c.stop()
+
+
+def test_pipelined_multichunk_upload_replicated_roundtrip(tmp_path):
+    """ISSUE 5 E2E: a pipelined multi-chunk upload through the filer —
+    fid lease cache on, chunk pipeline on, replication 010 (one replica
+    on another rack) — must be byte-identical on read-back, land every
+    chunk on BOTH racks, and cost far fewer master assigns than
+    chunks."""
+    import random
+
+    c = Cluster(tmp_path, n_volume_servers=2, racks=["r0", "r1"],
+                with_filer=True,
+                filer_kwargs={"chunk_size": 8192,
+                              "assign_lease_count": 16,
+                              "ingest_parallelism": 4})
+    try:
+        data = bytes(random.Random(5).getrandbits(8)
+                     for _ in range(100_000))        # 13 chunks of 8KB
+        with c.http(f"{c.filer.url}/big/blob.bin?replication=010",
+                    data=data, method="POST") as r:
+            assert r.status == 201
+            assert json.load(r)["size"] == len(data)
+
+        # byte-identical read-back through the filer
+        with c.http(f"{c.filer.url}/big/blob.bin") as r:
+            assert r.read() == data
+
+        entry = c.filer.filer.find_entry("/big/blob.bin")
+        chunks = list(entry.chunks)
+        assert len(chunks) == 13, [c_.offset for c_ in chunks]
+
+        # one lease batch covered many chunks: assigns << chunks
+        assert c.filer.leases is not None
+        assert c.filer.leases.assign_round_trips < len(chunks) / 2, \
+            f"{c.filer.leases.assign_round_trips} assigns for " \
+            f"{len(chunks)} chunks"
+
+        # every chunk readable from BOTH replicas, byte-identical
+        for ch in chunks:
+            f = parse_fid(ch.file_id)
+            locs = c.master.lookup_locations(f.volume_id)
+            assert len(locs) == 2, \
+                f"chunk {ch.file_id} not on both racks: {locs}"
+            copies = []
+            for url, _ in locs:
+                with c.http(f"{url}/{ch.file_id}") as r:
+                    copies.append(r.read())
+            assert copies[0] == copies[1]
+            assert copies[0] == data[ch.offset:ch.offset + ch.size]
+
+        # the replicated DELETE also rides the concurrent fan-out:
+        # every replica of every chunk must disappear
+        with c.http(f"{c.filer.url}/big/blob.bin",
+                    method="DELETE") as r:
+            assert r.status == 204
+        deadline = time.monotonic() + 10
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            gone = True
+            for ch in chunks:
+                f = parse_fid(ch.file_id)
+                for url, _ in c.master.lookup_locations(f.volume_id):
+                    try:
+                        c.http(f"{url}/{ch.file_id}").close()
+                        gone = False
+                    except urllib.error.HTTPError:
+                        pass
+            if not gone:
+                time.sleep(0.1)
+        assert gone, "chunk replicas survived the fanned-out delete"
     finally:
         c.stop()

@@ -2,6 +2,7 @@
 a real cluster (reference patterns: filer_server_handlers_write_autochunk
 tests + test/s3 integration style)."""
 
+import importlib.util
 import json
 import threading
 import urllib.error
@@ -194,6 +195,9 @@ class TestGrpc:
         assert "late.txt" in names
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="cryptography package not installed in this image")
 def test_cipher_filer_round_trip(tmp_path):
     c = Cluster(tmp_path, n_volume_servers=1, with_filer=True,
                 filer_kwargs={"cipher": True})

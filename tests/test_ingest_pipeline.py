@@ -1,0 +1,644 @@
+"""Ingest pipeline: fid lease cache, pipelined chunk uploads, and
+concurrent replica fan-out (ISSUE 5).
+
+Unit layer only — the fakes isolate each stage's contract (lease
+races, pipeline error latching, fan-out draining); the end-to-end
+proof lives in test_cluster.py::
+test_pipelined_multichunk_upload_replicated_roundtrip and the
+zero-cost-disabled invariants in test_perf_gates.py.
+"""
+
+import io
+import threading
+import time
+import types
+
+import pytest
+
+from seaweedfs_tpu.operation import operations
+from seaweedfs_tpu.operation.assign_lease import LeaseCache
+from seaweedfs_tpu.operation.file_id import parse_fid
+from seaweedfs_tpu.util.fanout import FanOutPool
+
+
+# -- fakes ---------------------------------------------------------------------
+
+
+class FakeMaster:
+    """assign_fn stand-in: hands out sequential keys, counts calls."""
+
+    def __init__(self, vid: int = 7, delay_s: float = 0.0,
+                 url: str = "127.0.0.1:7070"):
+        self.vid = vid
+        self.delay_s = delay_s
+        self.url = url
+        self.calls = []
+        self._next_key = 1
+        self._lock = threading.Lock()
+
+    def __call__(self, master_url, count=1, replication="",
+                 collection="", ttl="", data_center=""):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            key = self._next_key
+            self._next_key += count
+            self.calls.append((count, collection, replication))
+        return operations.Assignment(
+            f"{self.vid},{key:x}000000aa", self.url, self.url, count)
+
+
+# -- lease cache ---------------------------------------------------------------
+
+
+class TestLeaseCache:
+    def test_one_assign_covers_count_fids(self):
+        m = FakeMaster()
+        lc = LeaseCache(count=8, low_water=0, assign_fn=m)
+        fids = [lc.acquire("m").fid for _ in range(8)]
+        assert len(m.calls) == 1 and m.calls[0][0] == 8
+        assert len(set(fids)) == 8
+        keys = sorted(parse_fid(f).key for f in fids)
+        assert keys == list(range(keys[0], keys[0] + 8)), \
+            "leased fids must be the contiguous assigned batch"
+        assert all(parse_fid(f).volume_id == 7 for f in fids)
+
+    def test_low_water_triggers_async_refill(self):
+        m = FakeMaster()
+        lc = LeaseCache(count=8, low_water=2, assign_fn=m)
+        # cold miss banks 7; five more pops walk depth 6..2 — the pop
+        # that leaves depth==2 crosses the low-water mark
+        for _ in range(6):
+            lc.acquire("m")
+        deadline = time.monotonic() + 5.0
+        while len(m.calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)                  # refill is ASYNC
+        assert len(m.calls) == 2, "no refill below the low-water mark"
+        deadline = time.monotonic() + 5.0
+        while lc.depth() < 10 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert lc.depth() == 10, "refill never banked its batch"
+
+    def test_expired_leases_never_handed_out(self):
+        m = FakeMaster()
+        lc = LeaseCache(count=4, low_water=0, lease_ttl_s=0.03,
+                        assign_fn=m)
+        first = lc.acquire("m").fid
+        time.sleep(0.08)
+        second = lc.acquire("m").fid
+        assert len(m.calls) == 2, "expired bank must force a new assign"
+        assert parse_fid(second).key > parse_fid(first).key
+
+    def test_invalidate_drops_whole_volume(self):
+        m = FakeMaster()
+        lc = LeaseCache(count=8, low_water=0, assign_fn=m)
+        a = lc.acquire("m")
+        assert lc.depth() == 7
+        dropped = lc.invalidate(a.fid)
+        assert dropped == 7 and lc.depth() == 0
+        lc.acquire("m")
+        assert len(m.calls) == 2
+
+    def test_cold_pool_single_flight(self):
+        """W workers hitting an empty pool at once must cost ONE
+        count=N round trip, not W (the pipeline's cold-start shape)."""
+        m = FakeMaster(delay_s=0.05)
+        lc = LeaseCache(count=32, low_water=0, assign_fn=m)
+        fids, errs = [], []
+
+        def grab():
+            try:
+                fids.append(lc.acquire("m").fid)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=grab) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert len(m.calls) == 1, \
+            f"{len(m.calls)} assigns for one cold burst"
+        assert len(set(fids)) == 8
+
+    def test_pools_keyed_by_placement(self):
+        m = FakeMaster()
+        lc = LeaseCache(count=4, low_water=0, assign_fn=m)
+        lc.acquire("m", replication="000")
+        lc.acquire("m", replication="010")
+        assert len(m.calls) == 2, \
+            "distinct replication must not share a lease pool"
+        assert {c[2] for c in m.calls} == {"000", "010"}
+
+    def test_concurrent_acquire_with_expiry_race(self):
+        """Expiring leases under concurrent acquire never duplicate or
+        lose fids — every handed-out fid is unique."""
+        m = FakeMaster()
+        lc = LeaseCache(count=16, low_water=2, lease_ttl_s=0.01,
+                        assign_fn=m)
+        fids = []
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(20):
+                fid = lc.acquire("m").fid
+                with lock:
+                    fids.append(fid)
+                time.sleep(0.001)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(fids) == len(set(fids)), "duplicate fid handed out"
+
+
+# -- fan-out pool --------------------------------------------------------------
+
+
+class TestFanOutPool:
+    def test_construction_spawns_nothing(self):
+        before = threading.active_count()
+        FanOutPool(8, "idle-pool")
+        assert threading.active_count() == before
+
+    def test_run_is_concurrent_and_ordered(self):
+        pool = FanOutPool(4, "t-conc")
+
+        def slow(i):
+            time.sleep(0.1)
+            return i * 10
+
+        t0 = time.perf_counter()
+        out = pool.run([lambda i=i: slow(i) for i in range(4)])
+        wall = time.perf_counter() - t0
+        assert [r for r, _ in out] == [0, 10, 20, 30]
+        assert wall < 0.35, f"4x0.1s tasks took {wall:.2f}s (serial?)"
+
+    def test_run_drains_past_failures(self):
+        pool = FanOutPool(2, "t-drain")
+        done = []
+
+        def ok():
+            time.sleep(0.05)
+            done.append(1)
+            return "fine"
+
+        def boom():
+            raise RuntimeError("boom")
+
+        out = pool.run([boom, ok, ok])
+        assert isinstance(out[0][1], RuntimeError)
+        assert [r for r, e in out[1:]] == ["fine", "fine"]
+        assert len(done) == 2, "failure must not cancel siblings"
+
+
+# -- pipelined chunk uploads ---------------------------------------------------
+
+
+class RecordingVolumes:
+    """upload_data stand-in: records every chunk, optional failures."""
+
+    def __init__(self, fail_offsets=(), delay_s: float = 0.0):
+        self.fail_offsets = set(fail_offsets)
+        self.delay_s = delay_s
+        self.uploads = {}          # fid -> bytes
+        self.attempts = []
+        self._lock = threading.Lock()
+
+    def __call__(self, url_fid, data, mime="", fsync=False, **kw):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        fid = url_fid.rsplit("/", 1)[1]
+        with self._lock:
+            self.attempts.append(fid)
+            if len(data) >= 2 and data[:1] == b"\xfe":
+                # second byte tags WHICH poisoned chunk this was
+                raise RuntimeError(f"poisoned chunk tag {data[1]}")
+            self.uploads[fid] = bytes(data)
+        return {"eTag": f"tag-{fid}"}
+
+
+def make_filer(monkeypatch, tmp_path, chunk_size=100, parallelism=4,
+               volumes=None, lease_count=0, port=18888):
+    from seaweedfs_tpu.server import filer as filer_mod
+    vols = volumes if volumes is not None else RecordingVolumes()
+    master = FakeMaster()
+    monkeypatch.setattr(operations, "upload_data", vols)
+    monkeypatch.setattr(operations, "assign",
+                        lambda master_url, **kw: master(master_url, **kw))
+    fs = filer_mod.FilerServer(
+        master_url="127.0.0.1:1", port=port, store="memory",
+        chunk_size=chunk_size, ingest_parallelism=parallelism,
+        assign_lease_count=lease_count)
+    return fs, vols, master
+
+
+def reassemble(chunks, vols):
+    return b"".join(vols.uploads[c.file_id]
+                    for c in sorted(chunks, key=lambda c: c.offset))
+
+
+class TestPipelinedUploads:
+    def test_multichunk_ordered_and_byte_identical(self, monkeypatch,
+                                                   tmp_path):
+        fs, vols, _ = make_filer(monkeypatch, tmp_path, port=18881)
+        data = bytes(range(256)) * 41          # 10496 B -> 105 chunks
+        chunks = fs.upload_to_chunks(data)
+        assert len(chunks) == 105
+        assert [c.offset for c in chunks] == \
+            [i * 100 for i in range(105)]
+        assert sum(c.size for c in chunks) == len(data)
+        assert reassemble(chunks, vols) == data
+
+    def test_pipeline_matches_serial_shape(self, monkeypatch, tmp_path):
+        data = b"ab" * 555
+        fs_p, vols_p, _ = make_filer(monkeypatch, tmp_path, port=18882)
+        piped = fs_p.upload_to_chunks(data)
+        fs_s, vols_s, _ = make_filer(monkeypatch, tmp_path,
+                                     parallelism=1, port=18883)
+        serial = fs_s.upload_to_chunks(data)
+        assert [(c.offset, c.size) for c in piped] == \
+            [(c.offset, c.size) for c in serial]
+        assert reassemble(piped, vols_p) == reassemble(serial, vols_s)
+
+    def test_single_chunk_spawns_no_threads(self, monkeypatch, tmp_path):
+        fs, _, _ = make_filer(monkeypatch, tmp_path, port=18884)
+        fs.upload_to_chunks(b"tiny")
+        assert not [t.name for t in threading.enumerate()
+                    if t.name.startswith("ingest-18884")], \
+            "single-chunk body must stay on the caller thread"
+
+    def test_first_failure_cancels_tail(self, monkeypatch, tmp_path):
+        # chunk 5 and chunk 9 are poisoned (0xFE lead byte); the FIRST
+        # must surface and the far tail must never be submitted
+        data = bytearray(b"\x00" * 2000)       # 20 chunks of 100
+        data[500], data[501] = 0xFE, 5
+        data[900], data[901] = 0xFE, 9
+        vols = RecordingVolumes(delay_s=0.01)
+        fs, _, _ = make_filer(monkeypatch, tmp_path, volumes=vols,
+                              parallelism=2, port=18885)
+        with pytest.raises(RuntimeError) as ei:
+            fs.upload_to_chunks(bytes(data))
+        assert "tag 5" in str(ei.value), \
+            "must surface the FIRST failing chunk's error, got: " \
+            f"{ei.value}"
+        assert len(vols.attempts) <= 9, \
+            f"tail not cancelled: {len(vols.attempts)}/20 submitted"
+
+    def test_streaming_reader_byte_identical(self, monkeypatch,
+                                             tmp_path):
+        fs, vols, _ = make_filer(monkeypatch, tmp_path, port=18886)
+        data = bytes(reversed(range(256))) * 13   # 3328 B -> 34 chunks
+        chunks = fs.upload_stream_to_chunks(io.BytesIO(data), len(data))
+        assert len(chunks) == 34
+        assert reassemble(chunks, vols) == data
+
+    def test_streaming_short_body_raises(self, monkeypatch, tmp_path):
+        fs, _, _ = make_filer(monkeypatch, tmp_path, port=18887)
+        with pytest.raises((OSError, RuntimeError)):
+            fs.upload_stream_to_chunks(io.BytesIO(b"x" * 150), 450)
+
+    def test_leased_fid_failure_invalidates_and_retries(self,
+                                                        monkeypatch,
+                                                        tmp_path):
+        """A stale lease (volume went away) costs one retry on a fresh
+        assign, drops the volume's siblings, and the upload succeeds."""
+        calls = {"n": 0}
+        vols = RecordingVolumes()
+
+        def flaky_upload(url_fid, data, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("volume went read-only")
+            return vols(url_fid, data, **kw)
+
+        from seaweedfs_tpu.server import filer as filer_mod
+        master = FakeMaster()
+        monkeypatch.setattr(operations, "upload_data", flaky_upload)
+        monkeypatch.setattr(
+            operations, "assign",
+            lambda master_url, **kw: master(master_url, **kw))
+        fs = filer_mod.FilerServer(
+            master_url="127.0.0.1:1", port=18888, store="memory",
+            chunk_size=100, ingest_parallelism=1, assign_lease_count=8)
+        fs.leases._assign_fn = master
+        chunks = fs.upload_to_chunks(b"z" * 50)
+        assert len(chunks) == 1 and calls["n"] == 2
+        assert fs.leases.depth() == 0, \
+            "failed volume's banked leases must be dropped"
+
+
+# -- concurrent replica fan-out ------------------------------------------------
+
+
+def make_volume_server(tmp_path, monkeypatch, replicas, behaviors,
+                       port=28080):
+    """VolumeServer with one replicated volume and scripted replicas.
+
+    behaviors: url -> callable() -> (status, delay_s) or raises.
+    """
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.util import http_client
+    from seaweedfs_tpu.util.http_server import HeaderDict
+    d = tmp_path / f"vs{port}"
+    d.mkdir(parents=True, exist_ok=True)
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)],
+                      port=port, degraded_fleet=False)
+    vs.store.add_volume(1, replica_placement="001")
+    monkeypatch.setattr(vs, "_other_replicas", lambda vid: list(replicas))
+    done = []
+
+    def fake_request(method, url, body=None, headers=None, timeout=60.0,
+                     pooled=True):
+        host = url.split("/")[0]
+        status, delay = behaviors[host]()
+        if delay:
+            time.sleep(delay)
+        done.append((host, time.perf_counter()))
+        if status is None:
+            raise ConnectionRefusedError(f"{host} down")
+        return http_client.Response(status, HeaderDict(), b"{}")
+
+    monkeypatch.setattr(
+        "seaweedfs_tpu.server.volume.http_client.request", fake_request)
+    return vs, done
+
+
+class TestReplicaFanOut:
+    def test_slow_plus_failing_replica(self, tmp_path, monkeypatch):
+        """The failing replica fails the write; the slow one still
+        DRAINS (no dangling in-flight socket), and the first error is
+        the one surfaced."""
+        from seaweedfs_tpu.storage.needle import Needle, NeedleError
+        vs, done = make_volume_server(
+            tmp_path, monkeypatch,
+            replicas=["slow:80", "bad:80"],
+            behaviors={"slow:80": lambda: (201, 0.15),
+                       "bad:80": lambda: (500, 0.0)})
+        t0 = time.perf_counter()
+        with pytest.raises(NeedleError) as ei:
+            vs.replicated_write(1, Needle(id=5, cookie=9, data=b"pp"))
+        wall = time.perf_counter() - t0
+        assert "bad:80" in str(ei.value)
+        assert {h for h, _ in done} == {"slow:80", "bad:80"}, \
+            "slow replica must drain before the error surfaces"
+        assert wall >= 0.14, "error surfaced before the fan-out drained"
+        vs.store.close()
+
+    def test_fanout_is_concurrent(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.storage.needle import Needle
+        urls = [f"r{i}:80" for i in range(4)]
+        vs, done = make_volume_server(
+            tmp_path, monkeypatch, replicas=urls,
+            behaviors={u: (lambda: (201, 0.12)) for u in urls},
+            port=28081)
+        t0 = time.perf_counter()
+        vs.replicated_write(1, Needle(id=6, cookie=9, data=b"qq"))
+        wall = time.perf_counter() - t0
+        assert len(done) == 4
+        assert wall < 0.40, \
+            f"4 replicas x 0.12s took {wall:.2f}s — serial fan-out"
+        vs.store.close()
+
+    def test_replicated_delete_rides_fanout(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.storage.needle import Needle
+        urls = ["d0:80", "d1:80"]
+        vs, done = make_volume_server(
+            tmp_path, monkeypatch, replicas=urls,
+            behaviors={u: (lambda: (202, 0.1)) for u in urls},
+            port=28082)
+        vs.store.write_needle(1, Needle(id=7, cookie=9, data=b"x"))
+        t0 = time.perf_counter()
+        vs.replicated_delete(1, Needle(id=7, cookie=9))
+        wall = time.perf_counter() - t0
+        assert {h for h, _ in done} == set(urls)
+        assert wall < 0.35
+        vs.store.close()
+
+
+class TestReplicaUrlCache:
+    def _vs_with_counting_master(self, tmp_path, monkeypatch, port):
+        from seaweedfs_tpu.server import volume as volume_mod
+        from seaweedfs_tpu.server.volume import VolumeServer
+        d = tmp_path / f"vsc{port}"
+        d.mkdir(parents=True, exist_ok=True)
+        vs = VolumeServer(master_url="127.0.0.1:1",
+                          directories=[str(d)], port=port,
+                          degraded_fleet=False)
+        lookups = []
+
+        class FakeStub:
+            def LookupVolume(self, req):
+                lookups.append(req.volume_ids)
+                loc = types.SimpleNamespace(url="rep:80",
+                                            public_url="rep:80")
+                vl = types.SimpleNamespace(locations=[loc])
+                return types.SimpleNamespace(volume_id_locations=[vl])
+
+        monkeypatch.setattr(volume_mod, "master_stub",
+                            lambda addr: FakeStub())
+        return vs, lookups
+
+    def test_lookup_cached_across_writes(self, tmp_path, monkeypatch):
+        vs, lookups = self._vs_with_counting_master(
+            tmp_path, monkeypatch, 28083)
+        assert vs._other_replicas(1) == ["rep:80"]
+        assert vs._other_replicas(1) == ["rep:80"]
+        assert len(lookups) == 1, \
+            "replica urls must be cached, not re-asked per write"
+        vs.store.close()
+
+    def test_failure_invalidates_cache(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.storage.needle import Needle, NeedleError
+        vs, lookups = self._vs_with_counting_master(
+            tmp_path, monkeypatch, 28084)
+        vs.store.add_volume(1, replica_placement="001")
+
+        from seaweedfs_tpu.util import http_client
+        from seaweedfs_tpu.util.http_server import HeaderDict
+        monkeypatch.setattr(
+            "seaweedfs_tpu.server.volume.http_client.request",
+            lambda *a, **kw: http_client.Response(500, HeaderDict(),
+                                                  b""))
+        with pytest.raises(NeedleError):
+            vs.replicated_write(1, Needle(id=8, cookie=9, data=b"y"))
+        assert 1 not in vs._replica_urls, \
+            "replica POST failure must forget the vid's cached urls"
+        vs._other_replicas(1)
+        assert len(lookups) == 2
+        vs.store.close()
+
+    def test_empty_view_never_cached(self, tmp_path, monkeypatch):
+        """A replica mid-restart is briefly absent from the master's
+        answer; caching that empty view would ack a whole refresh
+        window of unreplicated writes. Empty views must be re-asked on
+        the next write."""
+        from seaweedfs_tpu.server import volume as volume_mod
+        from seaweedfs_tpu.server.volume import VolumeServer
+        d = tmp_path / "vse"
+        d.mkdir(parents=True, exist_ok=True)
+        vs = VolumeServer(master_url="127.0.0.1:1",
+                          directories=[str(d)], port=28086,
+                          degraded_fleet=False)
+        lookups = []
+        answers = [[], ["rep:80"]]   # first beat: only self known
+
+        class FlappyStub:
+            def LookupVolume(self, req):
+                lookups.append(req.volume_ids)
+                urls = answers[min(len(lookups) - 1, 1)]
+                locs = [types.SimpleNamespace(url=u, public_url=u)
+                        for u in urls]
+                vl = types.SimpleNamespace(locations=locs)
+                return types.SimpleNamespace(volume_id_locations=[vl])
+
+        monkeypatch.setattr(volume_mod, "master_stub",
+                            lambda addr: FlappyStub())
+        assert vs._other_replicas(1) == []
+        assert 1 not in vs._replica_urls, "empty view must not bank"
+        assert vs._other_replicas(1) == ["rep:80"]
+        assert len(lookups) == 2
+        vs.store.close()
+
+    def test_ttl_window_expires(self, tmp_path, monkeypatch):
+        from seaweedfs_tpu.server import volume as volume_mod
+        vs, lookups = self._vs_with_counting_master(
+            tmp_path, monkeypatch, 28085)
+        monkeypatch.setattr(volume_mod, "REPLICA_REFRESH_S", 0.05)
+        vs._other_replicas(1)
+        time.sleep(0.08)
+        vs._other_replicas(1)
+        assert len(lookups) == 2, "stale window must re-ask the master"
+        vs.store.close()
+
+
+# -- delete fan-out ------------------------------------------------------------
+
+
+def test_delete_files_fans_out_per_server(monkeypatch):
+    """Two volume servers, slow BatchDelete each: the batch delete must
+    overlap them (the serial walk took the sum)."""
+    from seaweedfs_tpu.operation import operations as ops
+
+    monkeypatch.setattr(
+        ops, "lookup",
+        lambda master, vid, collection="": [f"srv{vid % 2}:80"])
+
+    class SlowStub:
+        def __init__(self, url):
+            self.url = url
+
+        def BatchDelete(self, req):
+            time.sleep(0.12)
+            return types.SimpleNamespace(results=[
+                types.SimpleNamespace(file_id=f, status=202, error="",
+                                      size=3)
+                for f in req.file_ids])
+
+    monkeypatch.setattr(ops, "volume_stub", lambda url: SlowStub(url))
+    fids = ["2,10000000aa", "3,20000000bb", "4,30000000cc",
+            "5,40000000dd"]
+    t0 = time.perf_counter()
+    results = ops.delete_files("m", fids)
+    wall = time.perf_counter() - t0
+    assert sorted(r["fid"] for r in results) == sorted(fids)
+    assert all(r["status"] == 202 for r in results)
+    assert wall < 0.22, f"2 servers x 0.12s took {wall:.2f}s (serial)"
+
+
+def test_delete_files_surfaces_error_after_drain(monkeypatch):
+    from seaweedfs_tpu.operation import operations as ops
+
+    monkeypatch.setattr(
+        ops, "lookup",
+        lambda master, vid, collection="": [f"srv{vid % 2}:80"])
+    drained = []
+
+    class Stub:
+        def __init__(self, url):
+            self.url = url
+
+        def BatchDelete(self, req):
+            if self.url == "srv0:80":
+                raise RuntimeError("server gone")
+            time.sleep(0.05)
+            drained.append(self.url)
+            return types.SimpleNamespace(results=[])
+
+    monkeypatch.setattr(ops, "volume_stub", lambda url: Stub(url))
+    with pytest.raises(RuntimeError, match="server gone"):
+        ops.delete_files("m", ["2,10000000aa", "3,20000000bb"])
+    assert drained == ["srv1:80"], "healthy server must still drain"
+
+
+# -- http pool idle reaping ----------------------------------------------------
+
+
+class TestHttpPoolReaping:
+    @pytest.fixture()
+    def echo_server(self):
+        import socketserver
+        from http.server import BaseHTTPRequestHandler
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+            def log_message(self, *a):
+                pass
+
+        srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        yield f"127.0.0.1:{srv.server_address[1]}"
+        srv.shutdown()
+        srv.server_close()
+
+    def test_idle_conns_reaped_by_age(self, echo_server, monkeypatch):
+        import socket as socket_mod
+
+        from seaweedfs_tpu.util import http_client
+        http_client.close_all()
+        monkeypatch.setattr(http_client, "_IDLE_MAX_S", 0.05)
+        connects = []
+        orig = socket_mod.create_connection
+
+        def counting(addr, *a, **kw):
+            connects.append(addr)
+            return orig(addr, *a, **kw)
+
+        monkeypatch.setattr(socket_mod, "create_connection", counting)
+        assert http_client.request(
+            "GET", f"{echo_server}/a").status == 200
+        assert http_client.request(
+            "GET", f"{echo_server}/b").status == 200
+        assert len(connects) == 1, "fresh conn must be reused"
+        time.sleep(0.1)                       # exceed the idle cap
+        assert http_client.request(
+            "GET", f"{echo_server}/c").status == 200
+        assert len(connects) == 2, \
+            "conn past the idle age must be reaped, not reused"
+        assert http_client._idle_count() == 1
+        http_client.close_all()
+
+    def test_idle_gauge_tracks_pool(self, echo_server):
+        from seaweedfs_tpu.stats.metrics import REGISTRY
+        from seaweedfs_tpu.util import http_client
+        http_client.close_all()
+        assert http_client.request(
+            "GET", f"{echo_server}/x").status == 200
+        assert http_client._idle_count() == 1
+        assert "SeaweedFS_http_pool_idle_connections 1" in \
+            REGISTRY.render()
+        http_client.close_all()
+        assert "SeaweedFS_http_pool_idle_connections 0" in \
+            REGISTRY.render()

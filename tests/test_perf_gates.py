@@ -400,6 +400,81 @@ def test_degraded_decode_disabled_overhead(tmp_path):
         f"EC read with idle decode fleet {read_us:.0f} us/needle"
 
 
+def test_ingest_pipeline_disabled_overhead(tmp_path, monkeypatch):
+    """The ingest pipeline must be zero-cost until a multi-chunk body
+    actually arrives (ISSUE 5 contract, the fleet/cache/scrub twin for
+    the write subsystem).
+
+    Gates. Construction: a filer built without -assign.leaseCount
+    holds NO lease cache (the disabled assign path is one None check),
+    and neither the filer's ingest pool, the volume server's replicate
+    pool, nor operations' delete pool spawns a thread at construction.
+    Serial path: a single-chunk upload and a single-replica (000)
+    replicated write run entirely on the caller thread. Pipeline: only
+    a genuinely multi-chunk body wakes the pool, and it spawns at most
+    -ingest.parallelism threads."""
+    import threading
+
+    from seaweedfs_tpu.operation import operations
+    from seaweedfs_tpu.operation.assign_lease import LeaseCache
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.util.fanout import FanOutPool
+
+    PORT = 38888
+
+    def ingest_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith((f"ingest-{PORT}",
+                                      f"replicate-{PORT}",
+                                      "ingest-lease-refill"))]
+
+    FanOutPool(8, "gate-idle")          # constructing a pool is free
+    LeaseCache(count=8)                 # constructing the cache too
+    assert ingest_threads() == []
+
+    fs = FilerServer(master_url="127.0.0.1:1", port=PORT,
+                     chunk_size=1024, ingest_parallelism=4)
+    assert fs.leases is None, \
+        "default-config filer must not construct a lease cache"
+    assert ingest_threads() == [], \
+        "constructing the filer must not spawn ingest threads"
+
+    class _FakeAssign:
+        def __init__(self):
+            self.n = 0
+
+        def __call__(self, master_url, **kw):
+            self.n += 1
+            return operations.Assignment(
+                f"1,{self.n:x}000000aa", "stub:80", "stub:80", 1)
+
+    monkeypatch.setattr(operations, "assign", _FakeAssign())
+    monkeypatch.setattr(operations, "upload_data",
+                        lambda url_fid, data, **kw: {"eTag": "t"})
+    fs.upload_to_chunks(b"x" * 100)      # single chunk
+    assert ingest_threads() == [], \
+        "single-chunk upload must stay on the caller thread"
+    fs.upload_to_chunks(b"x" * 5000)     # 5 chunks: NOW the pool wakes
+    spawned = [t for t in ingest_threads()
+               if t.startswith(f"ingest-{PORT}")]
+    assert 0 < len(spawned) <= 4, \
+        f"pipeline threads outside (0, parallelism]: {spawned}"
+
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)],
+                      port=PORT, degraded_fleet=False)
+    vs.store.add_volume(1)               # replication 000
+    vs.replicated_write(1, Needle(id=1, cookie=9, data=b"solo"))
+    assert not [t for t in ingest_threads()
+                if t.startswith(f"replicate-{PORT}")], \
+        "single-copy write must never wake the replication pool"
+    vs.store.close()
+    fs.filer.close()
+
+
 def test_scrub_disabled_overhead(tmp_path):
     """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
     test_tracing_disabled_overhead twin for the integrity subsystem).
