@@ -614,7 +614,225 @@ def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
             "value": headline, "sweep": sweep}
 
 
+def chaos_sweep() -> dict:
+    """Resilience scenario sweep (ISSUE 6 satellite): an in-process
+    master + 3 volume servers take concurrent reads while the sweep
+    kills a replica, stalls a volume, and flaps the master. Per
+    scenario: p50/p99 latency + error rate. The point is the SHAPE —
+    failures must cost bounded latency (fail fast / hedge / fail over),
+    never hangs — so the gate is error-rate and tail bounds, not
+    throughput.
+
+    Scenarios:
+      healthy           baseline tail
+      kill_one_replica  one replica REALLY stopped; reads fail over,
+                        breakers turn the dead peer into a fast skip
+      slow_one_shard    one volume's reads stalled 200ms server-side;
+                        hedged reads bound the tail
+      flapping_master   master restarted mid-load; lookup-dependent
+                        reads ride the jittered deadline-capped retry
+    """
+    import tempfile
+    import threading
+
+    sys.path.insert(0, REPO_ROOT)
+    from tests.cluster_util import Cluster
+
+    from seaweedfs_tpu.resilience import Hedger, breaker, deadline, \
+        failpoint
+    from seaweedfs_tpu.util import http_client
+    from seaweedfs_tpu.util.retry import retry
+
+    n_threads = int(os.environ.get("BENCH_CHAOS_THREADS", "8"))
+    reads_per_thread = int(os.environ.get("BENCH_CHAOS_READS", "40"))
+    cookie = 0xBE9CBE9C
+
+    def fid(vid, key):
+        return f"{vid},{key:x}{cookie:08x}"
+
+    def run_scenario(read_one, keys):
+        lats, errs, lock = [], [], threading.Lock()
+
+        def worker(widx):
+            for it in range(reads_per_thread):
+                key = keys[(widx + it) % len(keys)]
+                t0 = time.perf_counter()
+                try:
+                    read_one(key)
+                except Exception as e:  # noqa: BLE001 - counted
+                    with lock:
+                        errs.append(repr(e))
+                    continue
+                with lock:
+                    lats.append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * reads_per_thread
+        ordered = sorted(lats) or [0.0]
+
+        def pct(q):
+            return round(
+                ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+                * 1000, 2)
+
+        return {"n": total, "p50_ms": pct(0.5), "p99_ms": pct(0.99),
+                "max_ms": round(ordered[-1] * 1000, 2),
+                "error_rate": round(len(errs) / total, 4),
+                "sample_error": errs[0][:120] if errs else ""}
+
+    out = {"metric": "chaos_sweep", "threads": n_threads,
+           "scenarios": {}}
+    with tempfile.TemporaryDirectory() as td:
+        import pathlib
+        cluster = Cluster(pathlib.Path(td), n_volume_servers=3,
+                          racks=["r1", "r2", "r3"])
+        stopped = []
+        try:
+            vs0, vs1, vs2 = cluster.volume_servers
+            for vid, servers in ((301, [vs0, vs1]), (302, [vs0, vs2])):
+                for vs in servers:
+                    vs.store.add_volume(vid, "",
+                                        replica_placement="010")
+                    vs.trigger_heartbeat()
+            cluster.wait_for(
+                lambda: all(len(cluster.master.topo.lookup(v)) == 2
+                            for v in (301, 302)),
+                what="volume registration")
+            blob = os.urandom(4096)
+            keys = list(range(1, 9))
+            for vid, primary in ((301, vs0), (302, vs0)):
+                for k in keys:
+                    r = http_client.request(
+                        "POST", f"{primary.url}/{fid(vid, k)}",
+                        body=blob)
+                    assert r.status == 201, r.status
+
+            breaker.configure(enable=True, threshold=3, cooldown_s=1.0)
+
+            def make_reader(name):
+                # one hedger per scenario so budget/win accounting in
+                # the emitted JSON is per-scenario, not cumulative
+                hedger = Hedger(delay_floor_s=0.02, max_inflight=64,
+                                name=name)
+
+                def hedged_read(vid, key, candidates):
+                    with deadline.budget(5.0):
+                        urls = breaker.sort_candidates(candidates)
+
+                        def one(u):
+                            r = http_client.request(
+                                "GET", f"{u}/{fid(vid, key)}",
+                                timeout=4.0)
+                            if r.status != 200:
+                                raise IOError(f"http {r.status}")
+                            if r.body != blob:
+                                raise IOError("bytes differ")
+                            return r.body
+                        return hedger.fetch(
+                            [lambda u=u: one(u) for u in urls])
+                return hedger, hedged_read
+
+            _, read_healthy = make_reader("bench-healthy")
+            out["scenarios"]["healthy"] = run_scenario(
+                lambda k: read_healthy(301, k, [vs0.url, vs1.url]),
+                keys)
+
+            vs1.stop()
+            stopped.append(vs1)
+            http_client.close_all()
+            _, read_kill = make_reader("bench-kill")
+            out["scenarios"]["kill_one_replica"] = run_scenario(
+                lambda k: read_kill(301, k, [vs1.url, vs0.url]), keys)
+
+            # slow-one-shard at the hedge design point: ~4% of traffic
+            # hits the stalled volume (hedging's 5% budget is sized for
+            # the p95 tail, not for a workload that is ALL stall — at
+            # higher stall shares the budget correctly caps hedges and
+            # the tail sits at the stall latency)
+            failpoint.arm("volume.read", "delay", arg=0.2,
+                          match={"server": vs2.url, "vid": "302"})
+            hedger3, read_slow = make_reader("bench-slow")
+
+            def mixed_read(k):
+                if k == 0:
+                    return read_slow(302, keys[0],
+                                     [vs2.url, vs0.url])
+                return read_slow(301, keys[k % len(keys)], [vs0.url])
+
+            out["scenarios"]["slow_one_shard"] = run_scenario(
+                mixed_read, list(range(25)))
+            failpoint.disarm()
+            out["scenarios"]["slow_one_shard"]["hedges"] = \
+                hedger3.hedges
+            out["scenarios"]["slow_one_shard"]["hedge_wins"] = \
+                hedger3.wins
+            out["scenarios"]["slow_one_shard"]["hedge_requests"] = \
+                hedger3.requests
+
+            # flapping master: down for ~0.5s mid-load; lookups ride
+            # the jittered retry with a 2s deadline cap
+            from seaweedfs_tpu.operation import operations
+
+            def lookup_read(k):
+                urls = retry(
+                    "bench.lookup",
+                    lambda: operations.lookup(
+                        cluster.master.url, 301),
+                    times=4, wait_seconds=0.05, deadline=2.0)
+                for u in breaker.sort_candidates(urls):
+                    r = http_client.request("GET",
+                                            f"{u}/{fid(301, k)}",
+                                            timeout=2.0)
+                    if r.status == 200:
+                        return
+                raise IOError("no replica")
+
+            def flap():
+                time.sleep(0.4)
+                cluster.master.stop()
+                from seaweedfs_tpu import rpc as rpc_mod
+                rpc_mod.close_channels()
+                time.sleep(0.5)
+                from seaweedfs_tpu.server.master import MasterServer
+                m2 = MasterServer(
+                    port=cluster.master.port,
+                    meta_dir=os.path.join(td, "master2"),
+                    pulse_seconds=0.2)
+                for _ in range(50):
+                    try:
+                        m2.start()
+                        break
+                    except OSError:
+                        time.sleep(0.2)
+                cluster.master = m2
+
+            flapper = threading.Thread(target=flap)
+            flapper.start()
+            out["scenarios"]["flapping_master"] = run_scenario(
+                lookup_read, keys)
+            flapper.join()
+        finally:
+            failpoint.disarm()
+            breaker.reset()
+            cluster.volume_servers = [
+                v for v in cluster.volume_servers if v not in stopped]
+            cluster.stop()
+    return out
+
+
 def main() -> None:
+    if "--chaos" in sys.argv:
+        line = chaos_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_CHAOS.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
     if "--ingest" in sys.argv:
         # ingest mode is host-pipeline only: filer write-path
         # throughput, not the kernel headline

@@ -194,7 +194,46 @@ def _volume_parser() -> argparse.ArgumentParser:
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
+    _add_resilience_args(p)
     return p
+
+
+def _add_resilience_args(p: argparse.ArgumentParser) -> None:
+    """Shared -resilience.* flags (volume + filer; see
+    seaweedfs_tpu/resilience/). Everything defaults OFF — the
+    resilience layer costs nothing until enabled."""
+    p.add_argument("-resilience.breaker", dest="resilience_breaker",
+                   action="store_true",
+                   help="per-peer circuit breakers: fail fast on dead "
+                        "peers instead of waiting out connect timeouts")
+    p.add_argument("-resilience.breakerThreshold",
+                   dest="resilience_breaker_threshold", type=int,
+                   default=5,
+                   help="consecutive failures that open a peer's breaker")
+    p.add_argument("-resilience.breakerCooldownS",
+                   dest="resilience_breaker_cooldown", type=float,
+                   default=5.0,
+                   help="seconds an open breaker waits before the "
+                        "half-open probe")
+    p.add_argument("-resilience.hedge", dest="resilience_hedge",
+                   action="store_true",
+                   help="hedged reads: after the tracked p95, send one "
+                        "speculative request to another replica/shard "
+                        "holder (<=5%% extra-request budget)")
+    p.add_argument("-resilience.hedgeDelayMs",
+                   dest="resilience_hedge_delay_ms", type=float,
+                   default=10.0,
+                   help="floor for the hedge delay (the tracked p95 "
+                        "takes over once measured)")
+
+
+def _configure_resilience(opts) -> None:
+    if opts.resilience_breaker:
+        from seaweedfs_tpu.resilience import breaker
+        breaker.configure(
+            enable=True,
+            threshold=opts.resilience_breaker_threshold,
+            cooldown_s=opts.resilience_breaker_cooldown)
 
 
 def _storage_backend_conf() -> dict:
@@ -234,13 +273,16 @@ def _build_volume(opts):
         cache_dir=opts.cache_dir or None,
         degraded_fleet=opts.degraded_fleet,
         degraded_batch_ms=opts.degraded_batch_ms,
-        replicate_parallel=opts.replicate_parallel)
+        replicate_parallel=opts.replicate_parallel,
+        hedge_reads=opts.resilience_hedge,
+        hedge_delay_ms=opts.resilience_hedge_delay_ms)
 
 
 @command("volume", "start a volume server (data plane)")
 def run_volume(args) -> int:
     _setup_tls("volume")
     opts = _volume_parser().parse_args(args)
+    _configure_resilience(opts)
     grace.setup_profiling(opts.cpuprofile)
     _maybe_start_metrics(opts, role="volume")
     vs = _build_volume(opts)
@@ -280,6 +322,7 @@ def _filer_parser() -> argparse.ArgumentParser:
                         "this cluster (merged metadata view)")
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
+    _add_resilience_args(p)
     return p
 
 
@@ -302,7 +345,9 @@ def _build_filer(opts):
         cache_dir=os.path.join(opts.dir, "cache"),
         peers=peers,
         ingest_parallelism=opts.ingest_parallelism,
-        assign_lease_count=opts.assign_lease_count)
+        assign_lease_count=opts.assign_lease_count,
+        hedge_reads=opts.resilience_hedge,
+        hedge_delay_ms=opts.resilience_hedge_delay_ms)
     # notification.toml: publish every metadata mutation to the first
     # enabled [notification.X] queue (reference filer.go
     # LoadConfiguration("notification"))
@@ -318,6 +363,7 @@ def _build_filer(opts):
 def run_filer(args) -> int:
     _setup_tls("filer")
     opts = _filer_parser().parse_args(args)
+    _configure_resilience(opts)
     _maybe_start_metrics(opts, role="filer")
     fs = _build_filer(opts)
     fs.start()

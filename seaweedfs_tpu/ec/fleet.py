@@ -57,6 +57,7 @@ from seaweedfs_tpu.ec import encoder as _encoder
 from seaweedfs_tpu.ec.encoder import (
     LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, default_chunk_for, shard_file_name)
 from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS, TOTAL_SHARDS
+from seaweedfs_tpu.resilience import failpoint as _failpoint
 from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.stats.metrics import (
     FleetDispatchBatchHistogram, FleetDispatchedBytesCounter,
@@ -286,6 +287,8 @@ class _Dispatcher:
                 thread_name_prefix="fleet-encode")
 
     def encode(self, arrays: List[np.ndarray]):
+        if _failpoint._armed:
+            _failpoint.hit("fleet.dispatch", op="encode")
         if self._pool is None:
             data = arrays[0] if len(arrays) == 1 else \
                 np.concatenate(arrays, axis=0)
@@ -298,6 +301,8 @@ class _Dispatcher:
                           for a in arrays])
 
     def reconstruct(self, present, missing, arrays: List[np.ndarray]):
+        if _failpoint._armed:
+            _failpoint.hit("fleet.dispatch", op="reconstruct")
         if self._pool is None:
             src = np.stack(arrays, axis=0)  # [B, 10, span]
             handle = self._rs.reconstruct_some_async(

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Callable, Iterator, List, Optional
 
+from seaweedfs_tpu.resilience import breaker, deadline
 from seaweedfs_tpu.util import http_client
 
 from seaweedfs_tpu.filer import filechunks
@@ -35,36 +36,63 @@ def filer_lookup_fn(stub) -> LookupFn:
     return lookup
 
 
+def _fetch_one(url: str, file_id: str) -> bytes:
+    """One replica's raw stored chunk bytes; raises on any failure so
+    hedged/failover callers can move to the next candidate."""
+    # pooled keep-alive client: chunk fetches are the filer read
+    # path's inner hop, and a fresh connection per chunk is both a
+    # syscall tax and an occasional 1s SYN-retransmit p99 spike
+    r = http_client.request(
+        "GET", f"{url}/{file_id}",
+        # raw stored bytes, no server-side decompression
+        headers={"Accept-Encoding": "gzip"}, timeout=60.0)
+    if r.status != 200:
+        raise IOError(f"http {r.status}")
+    return r.body
+
+
 def fetch_chunk_bytes(lookup: LookupFn, file_id: str,
                       cipher_key: bytes = b"",
                       is_compressed: bool = False,
-                      cache: Optional[TieredChunkCache] = None) -> bytes:
-    """The full decoded chunk (decrypted + decompressed)."""
+                      cache: Optional[TieredChunkCache] = None,
+                      hedger=None) -> bytes:
+    """The full decoded chunk (decrypted + decompressed).
+
+    Candidate replicas are breaker-sorted (open-breaker peers last);
+    with a resilience.Hedger wired (-resilience.hedge on the filer) a
+    read that outlives the tracked p95 issues ONE hedge to the next
+    replica and the first response wins."""
     if cache is not None:
         hit = cache.get(file_id)
         if hit is not None:
             return hit
-    urls = lookup(file_id)
-    err: Optional[Exception] = None
+    urls = breaker.sort_candidates(lookup(file_id))
     data = None
-    for url in urls:
-        # pooled keep-alive client: chunk fetches are the filer read
-        # path's inner hop, and a fresh connection per chunk is both a
-        # syscall tax and an occasional 1s SYN-retransmit p99 spike
+    if hedger is not None and len(urls) > 1:
         try:
-            r = http_client.request(
-                "GET", f"{url}/{file_id}",
-                # raw stored bytes, no server-side decompression
-                headers={"Accept-Encoding": "gzip"}, timeout=60.0)
-        except OSError as e:  # incl. http_client._StaleConnection
-            err = e
-            continue
-        if r.status == 200:
-            data = r.body
-            break
-        err = IOError(f"http {r.status}")
-    if data is None:
-        raise IOError(f"fetch {file_id}: no reachable replica: {err}")
+            data = hedger.fetch(
+                [lambda u=u: _fetch_one(u, file_id) for u in urls])
+        except deadline.DeadlineExceeded:
+            # same 504 contract as the non-hedged branch below —
+            # DeadlineExceeded IS an OSError, so it must dodge the
+            # rewrap or enabling hedging would turn 504s into 500s
+            raise
+        except (OSError, IOError) as e:
+            raise IOError(f"fetch {file_id}: no reachable replica: {e}")
+    else:
+        err: Optional[Exception] = None
+        for url in urls:
+            try:
+                data = _fetch_one(url, file_id)
+                break
+            except deadline.DeadlineExceeded:
+                # a spent budget is not "no reachable replica" — it
+                # must surface as the 504 the client's header asked for
+                raise
+            except OSError as e:  # incl. http_client._StaleConnection
+                err = e
+        if data is None:
+            raise IOError(f"fetch {file_id}: no reachable replica: {err}")
     if cipher_key:
         data = decrypt(data, cipher_key)
     if is_compressed:
@@ -76,12 +104,12 @@ def fetch_chunk_bytes(lookup: LookupFn, file_id: str,
 
 def stream_content(lookup: LookupFn, chunks: List[filer_pb2.FileChunk],
                    offset: int = 0, size: Optional[int] = None,
-                   cache: Optional[TieredChunkCache] = None
-                   ) -> Iterator[bytes]:
+                   cache: Optional[TieredChunkCache] = None,
+                   hedger=None) -> Iterator[bytes]:
     """Yield the file's visible bytes for [offset, offset+size)."""
     def fetch(c: filer_pb2.FileChunk) -> bytes:
         return fetch_chunk_bytes(lookup, c.file_id, bytes(c.cipher_key),
-                                 c.is_compressed, cache)
+                                 c.is_compressed, cache, hedger=hedger)
 
     chunks = resolve_chunk_manifest(fetch, list(chunks))
     views = filechunks.view_from_chunks(chunks, offset, size)
@@ -90,7 +118,8 @@ def stream_content(lookup: LookupFn, chunks: List[filer_pb2.FileChunk],
         if view.logic_offset > pos:  # hole: sparse zeros
             yield b"\x00" * (view.logic_offset - pos)
         whole = fetch_chunk_bytes(lookup, view.file_id, view.cipher_key,
-                                  view.is_compressed, cache)
+                                  view.is_compressed, cache,
+                                  hedger=hedger)
         yield whole[view.offset:view.offset + view.size]
         pos = view.logic_offset + view.size
     if size is not None and pos < offset + size:

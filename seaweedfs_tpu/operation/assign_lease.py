@@ -69,6 +69,7 @@ class LeaseCache:
         self._lock = threading.Lock()
         self._pools: Dict[_PoolKey, Deque[_Lease]] = {}
         self._refilling: set = set()
+        self._closed = False
         # single-flight for the MISS path: a cold pool hit by W pipeline
         # workers at once must cost one count=N round trip, not W
         self._fill_locks: Dict[_PoolKey, threading.Lock] = {}
@@ -89,17 +90,20 @@ class LeaseCache:
         """One count=N master round trip -> (first Assignment, rest)."""
         from seaweedfs_tpu.stats import trace
         master, collection, replication, ttl, dc = key
-        sp = trace.span("ingest.assign", count=self.count) \
+        # after close() nothing gets banked, so reserving N keys would
+        # leak N-1 fids per drain-phase upload — ask for exactly one
+        count = 1 if self._closed else self.count
+        sp = trace.span("ingest.assign", count=count) \
             if trace.is_enabled() else trace.NOOP
         with sp:
             a = self._assign_fn(
-                master, count=self.count, replication=replication,
+                master, count=count, replication=replication,
                 collection=collection, ttl=ttl, data_center=dc)
         from seaweedfs_tpu.stats.metrics import IngestLeaseAssignsCounter
         IngestLeaseAssignsCounter.inc()
         with self._lock:
             self.assign_round_trips += 1
-        granted = max(1, min(self.count, a.count or 1))
+        granted = max(1, min(count, a.count or 1))
         f = parse_fid(a.fid)
         expires = time.monotonic() + self.lease_ttl_s
         leases = [
@@ -110,14 +114,17 @@ class LeaseCache:
 
     def _bank(self, key: _PoolKey, leases) -> None:
         with self._lock:
+            if self._closed:   # shutdown: stop banking, serve direct
+                return
             self._pools.setdefault(key, deque()).extend(leases)
             self._export_depth_locked()
 
     def _refill_async(self, key: _PoolKey) -> None:
         def run():
             try:
-                first, rest = self._assign_batch(key)
-                self._bank(key, [first] + rest)
+                if not self._closed:
+                    first, rest = self._assign_batch(key)
+                    self._bank(key, [first] + rest)
             except Exception:
                 pass  # next miss refills synchronously and surfaces it
             finally:
@@ -152,7 +159,8 @@ class LeaseCache:
                 self.served_from_pool += 1
                 # low_water=0 disables the async refill entirely:
                 # misses refill synchronously, nothing else does
-                if 0 < self.low_water >= len(pool) and \
+                if not self._closed and \
+                        0 < self.low_water >= len(pool) and \
                         key not in self._refilling:
                     self._refilling.add(key)
                     spawn_refill = True
@@ -190,6 +198,16 @@ class LeaseCache:
             self._bank(key, rest)
         return operations.Assignment(first.fid, first.url,
                                      first.public_url, 1)
+
+    def close(self) -> None:
+        """Shutdown (util/grace path via FilerServer.stop): drop the
+        banked leases and stop spawning refills. acquire() keeps
+        working — it just goes straight to the master — so in-flight
+        uploads drain instead of erroring."""
+        with self._lock:
+            self._closed = True
+            self._pools.clear()
+            self._export_depth_locked()
 
     def invalidate(self, fid: str) -> int:
         """The caller's upload to `fid` failed at the volume server:

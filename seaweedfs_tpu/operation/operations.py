@@ -11,9 +11,10 @@ import gzip as gzip_mod
 import json
 import secrets
 import urllib.parse
-from typing import Dict, List, NamedTuple
+from typing import Dict, List, NamedTuple, Optional
 
 from seaweedfs_tpu.pb import master_pb2, master_stub, volume_server_pb2, volume_stub
+from seaweedfs_tpu.resilience import breaker
 from seaweedfs_tpu.util import http_client
 from seaweedfs_tpu.util.fanout import FanOutPool
 
@@ -219,7 +220,15 @@ def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
     urls = lookup(master_url, parse_fid(fid).volume_id)
     if not urls:
         raise RuntimeError(f"no locations for {fid}")
-    return download_url(f"{urls[0]}/{fid}", timeout=timeout)
+    # open-breaker replicas sort last, and a failed replica falls
+    # through to the next instead of failing the read
+    last_err: Optional[Exception] = None
+    for url in breaker.sort_candidates(urls):
+        try:
+            return download_url(f"{url}/{fid}", timeout=timeout)
+        except (OSError, RuntimeError) as e:
+            last_err = e
+    raise last_err
 
 
 def download_url(url_fid: str, timeout: float = 60.0) -> bytes:
@@ -268,7 +277,9 @@ def delete_files(master_url: str, fids: List[str]) -> List[dict]:
             results.extend({"fid": f, "error": "no locations"}
                            for f in group)
             continue
-        by_server.setdefault(urls[0], []).extend(group)
+        # an open-breaker primary demotes behind its healthy replicas
+        by_server.setdefault(breaker.sort_candidates(urls)[0],
+                             []).extend(group)
 
     def delete_on(url, group):
         resp = volume_stub(url).BatchDelete(

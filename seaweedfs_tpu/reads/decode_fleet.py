@@ -45,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, TOTAL_SHARDS, ReedSolomon
+from seaweedfs_tpu.resilience import deadline as deadline_mod
 from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.stats.metrics import (
     ReadsDecodedBytesCounter, ReadsDegradedBatchHistogram,
@@ -202,7 +203,21 @@ class DegradedReadFleet:
             # the put — fail whatever is queued (including req) now
             # rather than letting callers wait out the full timeout
             self._fail_pending("decode fleet stopped")
-        if not req.done.wait(timeout=60):
+        # a request whose client already gave up must not pin this
+        # handler thread for the full fleet timeout — cap the wait to
+        # the ambient budget (the batch may still retire for siblings)
+        wait_s = 60.0
+        rem = deadline_mod.remaining()
+        if rem is not None:
+            if rem <= 0:
+                raise deadline_mod.DeadlineExceeded(
+                    f"degraded read vid {ecv.volume_id}")
+            wait_s = min(wait_s, rem)
+        if not req.done.wait(timeout=wait_s):
+            if deadline_mod.expired():
+                raise deadline_mod.DeadlineExceeded(
+                    f"degraded read vid {ecv.volume_id} "
+                    f"shard {missing_shard}")
             req.error = EcShardNotFound(
                 f"vid {ecv.volume_id} shard {missing_shard}: decode "
                 "fleet timed out")

@@ -1,0 +1,28 @@
+"""Cluster-wide resilience substrate.
+
+Four pieces, each zero-cost until armed/enabled (the house rule every
+subsystem in this tree follows, gated in tests/test_perf_gates.py):
+
+  failpoint   named fault-injection sites compiled into the hot paths
+              (SEAWEED_FAILPOINTS env / POST /debug/failpoint)
+  deadline    per-request budget carried in a contextvar in-process
+              and the X-Seaweed-Deadline header across hops
+  breaker     per-peer circuit breakers (closed/open/half-open) so a
+              dead peer fails fast instead of pinning fan-out lanes
+  hedge       p95-delayed hedged reads, first response wins, bounded
+              by a <=5% extra-request budget
+
+See ARCHITECTURE.md "Resilience & fault injection".
+"""
+
+from seaweedfs_tpu.resilience import breaker, deadline, failpoint
+from seaweedfs_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from seaweedfs_tpu.resilience.deadline import DeadlineExceeded
+from seaweedfs_tpu.resilience.failpoint import FailpointError
+from seaweedfs_tpu.resilience.hedge import Hedger
+
+__all__ = [
+    "breaker", "deadline", "failpoint",
+    "BreakerOpen", "CircuitBreaker", "DeadlineExceeded",
+    "FailpointError", "Hedger",
+]

@@ -1,0 +1,204 @@
+"""Per-peer circuit breakers for the data plane.
+
+A dead volume server must fail requests in microseconds, not tie up a
+fan-out lane for a connect timeout per request. Classic three-state
+breaker (the Hystrix/gRPC-lb shape):
+
+  CLOSED      traffic flows; `threshold` CONSECUTIVE failures open it
+  OPEN        every call fails fast with BreakerOpen until
+              `cooldown_s` elapses
+  HALF_OPEN   exactly one probe request is let through; success
+              closes the breaker, failure re-opens it (and restarts
+              the cooldown)
+
+State is keyed by peer netloc ("host:port") in a process-wide
+registry, exported as `SeaweedFS_breaker_state{peer}` (0 closed,
+1 half-open, 2 open) plus a transitions counter — the signals the
+chaos harness asserts on.
+
+What counts as failure: connection-level errors (OSError — includes
+injected FailpointError and exhausted deadlines are NOT recorded, see
+util/http_client). An HTTP response of any status is proof of life and
+records success.
+
+Off by default: `enabled` is False until `-resilience.breaker` /
+configure(enabled=True), and while disabled every entry point is one
+module-flag check (gated by tests/test_perf_gates.py::
+test_breaker_hedge_deadline_disabled_overhead).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+CLOSED, HALF_OPEN, OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
+
+# module-level switch: the hot-path guard
+enabled = False
+
+_lock = threading.Lock()
+_registry: Dict[str, "CircuitBreaker"] = {}
+_threshold = 5
+_cooldown_s = 5.0
+
+
+class BreakerOpen(OSError):
+    """Fail-fast refusal: the peer's breaker is open. Subclasses
+    OSError so data-plane error handling treats it as the connect
+    failure it predicts — but retry's default classifier never burns
+    attempts on it."""
+
+    def __init__(self, peer: str):
+        super().__init__(f"circuit breaker open for {peer}")
+        self.peer = peer
+
+
+class CircuitBreaker:
+    """One peer's state machine. allow() + record(ok) are the whole
+    protocol; both are O(1) under a per-breaker lock."""
+
+    def __init__(self, peer: str, threshold: int = 5,
+                 cooldown_s: float = 5.0):
+        self.peer = peer
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        self._export(CLOSED)
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            # surface OPEN->HALF_OPEN lazily so status readers see the
+            # recoverable state without waiting for the next request
+            if self._state == OPEN and \
+                    time.monotonic() - self._opened_at >= self.cooldown_s:
+                self._transition(HALF_OPEN)
+            return self._state
+
+    def allow(self) -> bool:
+        """May a request go to this peer right now? Transitioning
+        OPEN -> HALF_OPEN reserves the single probe slot for the
+        caller that got True."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            now = time.monotonic()
+            if self._state == OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                self._transition(HALF_OPEN)
+            # HALF_OPEN: exactly one probe in flight. A probe whose
+            # caller never called record() — died mid-flight, or bailed
+            # on a spent deadline — is reclaimed after cooldown_s, or
+            # the peer's breaker would wedge open forever
+            if self._probe_inflight and \
+                    now - self._probe_started < self.cooldown_s:
+                return False
+            self._probe_inflight = True
+            self._probe_started = now
+            return True
+
+    def record(self, ok: bool) -> None:
+        with self._lock:
+            self._probe_inflight = False
+            if ok:
+                self._consecutive_failures = 0
+                if self._state != CLOSED:
+                    self._transition(CLOSED)
+                return
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED and
+                    self._consecutive_failures >= self.threshold):
+                self._opened_at = time.monotonic()
+                self._transition(OPEN)
+
+    def _transition(self, to: int) -> None:
+        # caller holds self._lock
+        self._state = to
+        self._export(to)
+        from seaweedfs_tpu.stats.metrics import BreakerTransitionsCounter
+        BreakerTransitionsCounter.labels(self.peer,
+                                         _STATE_NAMES[to]).inc()
+
+    def _export(self, state: int) -> None:
+        from seaweedfs_tpu.stats.metrics import BreakerStateGauge
+        BreakerStateGauge.labels(self.peer).set(state)
+
+
+# -- module-level registry ----------------------------------------------------
+
+
+def configure(enable: Optional[bool] = None,
+              threshold: Optional[int] = None,
+              cooldown_s: Optional[float] = None) -> None:
+    """Process-wide breaker config (-resilience.breaker* flags).
+    Parameter changes apply to breakers created afterwards."""
+    global enabled, _threshold, _cooldown_s
+    if enable is not None:
+        enabled = enable
+    if threshold is not None:
+        _threshold = max(1, int(threshold))
+    if cooldown_s is not None:
+        _cooldown_s = float(cooldown_s)
+
+
+def reset() -> None:
+    """Drop every breaker and disable (tests)."""
+    global enabled
+    with _lock:
+        _registry.clear()
+        enabled = False
+
+
+def for_peer(peer: str) -> CircuitBreaker:
+    with _lock:
+        b = _registry.get(peer)
+        if b is None:
+            b = CircuitBreaker(peer, threshold=_threshold,
+                               cooldown_s=_cooldown_s)
+            _registry[peer] = b
+        return b
+
+
+def check(peer: str) -> None:
+    """Raise BreakerOpen when `peer`'s breaker refuses traffic.
+    No-op while breakers are disabled."""
+    if not enabled:
+        return
+    if not for_peer(peer).allow():
+        raise BreakerOpen(peer)
+
+
+def record(peer: str, ok: bool) -> None:
+    if not enabled:
+        return
+    for_peer(peer).record(ok)
+
+
+def is_open(peer: str) -> bool:
+    """True when a breaker EXISTS for peer and is open — never creates
+    one (candidate sorting must not populate the registry)."""
+    if not enabled:
+        return False
+    with _lock:
+        b = _registry.get(peer)
+    return b is not None and b.state == OPEN
+
+
+def sort_candidates(urls: Sequence[str]) -> List[str]:
+    """Stable re-sort of peer candidates: open-breaker peers last (not
+    dropped — a last-resort attempt through them is the half-open
+    probe path when everything else is down too)."""
+    urls = list(urls)
+    if not enabled or len(urls) <= 1:
+        return urls
+    return sorted(urls, key=lambda u: 1 if is_open(u) else 0)

@@ -1,0 +1,123 @@
+"""Per-request deadline budget, propagated across hops.
+
+A client that gives up after 2 s must not leave the filer retrying a
+volume upload for 60 s on its behalf — at millions of users that
+abandoned work IS the overload (Dean & Barroso, "The Tail at Scale").
+The budget travels two ways:
+
+  in-process   a contextvar holding the ABSOLUTE monotonic deadline.
+               Thread pools that carry requests across threads
+               (util/fanout.FanOutPool) copy the context at submit so
+               the budget follows the work.
+  cross-hop    the REMAINING seconds ride the `X-Seaweed-Deadline`
+               header (HTTP) and the gRPC call deadline. Remaining —
+               never an absolute time — because hosts do not share a
+               clock. Each receiving server re-anchors the budget
+               against its own monotonic clock, so the chain
+               filer -> volume -> replica shrinks the budget at every
+               hop and the deepest hop stops first.
+
+Enforcement points (all no-ops when no budget is set):
+  - util/http_client.request refuses exhausted budgets and sizes the
+    socket timeout to min(timeout, remaining)
+  - rpc.make_stub caps every outbound gRPC call's deadline
+  - util/retry.retry stops backing off once the budget is spent
+  - reads/decode_fleet.decode caps its batch wait
+
+Zero-cost-disabled contract: with no deadline set the hot path pays
+one ContextVar.get() returning None (gated by
+tests/test_perf_gates.py::test_breaker_hedge_deadline_disabled_overhead).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+# Wire name for the remaining-seconds header (HTTP). Lowercase twin is
+# what FastHandler's HeaderDict stores.
+HEADER = "X-Seaweed-Deadline"
+HEADER_LOWER = "x-seaweed-deadline"
+
+_deadline: "contextvars.ContextVar[Optional[float]]" = \
+    contextvars.ContextVar("seaweed_deadline", default=None)
+
+
+class DeadlineExceeded(OSError):
+    """The request's budget ran out. Subclasses OSError so data-plane
+    error handling (which treats OSError as a failed hop) needs no new
+    except arms — but retry/default_retryable knows never to retry it."""
+
+    def __init__(self, what: str = ""):
+        super().__init__(f"deadline exceeded{': ' + what if what else ''}")
+
+
+def get() -> Optional[float]:
+    """The absolute monotonic deadline, or None when unbudgeted."""
+    return _deadline.get()
+
+
+def remaining() -> Optional[float]:
+    """Seconds left in the budget (may be <= 0), or None."""
+    d = _deadline.get()
+    return None if d is None else d - time.monotonic()
+
+
+def expired() -> bool:
+    d = _deadline.get()
+    return d is not None and time.monotonic() >= d
+
+
+def check(what: str = "") -> None:
+    """Raise DeadlineExceeded when the ambient budget is spent."""
+    d = _deadline.get()
+    if d is not None and time.monotonic() >= d:
+        raise DeadlineExceeded(what)
+
+
+def set_budget(seconds: float) -> "contextvars.Token":
+    """Set the ambient budget to `seconds` from now — never EXTENDING
+    an existing budget (an inner hop cannot grant itself more time than
+    its caller gave it). Returns a token for reset()."""
+    d = time.monotonic() + max(0.0, seconds)
+    cur = _deadline.get()
+    if cur is not None:
+        d = min(cur, d)
+    return _deadline.set(d)
+
+
+def reset(token: "contextvars.Token") -> None:
+    _deadline.reset(token)
+
+
+@contextmanager
+def budget(seconds: float):
+    """`with deadline.budget(2.0): ...` — scoped budget."""
+    token = set_budget(seconds)
+    try:
+        yield
+    finally:
+        reset(token)
+
+
+def header_value() -> Optional[str]:
+    """The remaining budget formatted for X-Seaweed-Deadline, or None.
+    Clamped at 0 so a just-expired budget still propagates as exhausted
+    rather than disappearing."""
+    rem = remaining()
+    return None if rem is None else f"{max(rem, 0.0):.4f}"
+
+
+def parse_header(value: str) -> Optional[float]:
+    """Remaining-seconds from a header value; None on junk (a malformed
+    header must never fail the request — it just carries no budget)."""
+    try:
+        rem = float(value)
+    except (TypeError, ValueError):
+        return None
+    # negative/NaN from a clock-confused peer: treat as exhausted
+    if rem != rem:
+        return None
+    return max(rem, 0.0)

@@ -1,0 +1,240 @@
+"""Hedged reads: a second request to another replica after p95.
+
+"The Tail at Scale" containment move: when a read has taken longer
+than the tracked p95, send ONE hedge to the next candidate replica /
+shard holder; first response wins, the loser is abandoned. Two bounds
+keep hedging from amplifying an overload:
+
+  budget   hedges are capped at `budget_pct` (default 5%) of all
+           hedge-eligible requests — by construction waiting for p95
+           only ~5% of requests are slow enough to want one, and the
+           hard cap holds when a stalled peer pushes that share up.
+           Denials are counted (SeaweedFS_hedge_budget_denied_total).
+  lanes    at most `max_inflight` candidate fetches ride the pool at
+           once. Past that, fetch() degrades to a plain inline call —
+           an abandoned loser pinned on a stalled socket must never
+           head-of-line-block fresh requests behind it.
+
+Failover is NOT hedging: when the primary FAILS (raises), the next
+candidate launches immediately and is not charged to the hedge budget
+— that attempt was mandatory work, not speculation.
+
+Zero-cost-disabled contract: servers hold `hedger = None` unless
+-resilience.hedge is set (the read path's hedge branch is a None
+check), and a constructed Hedger spawns nothing until its first
+multi-candidate fetch (FanOutPool discipline, gated by
+tests/test_perf_gates.py::test_breaker_hedge_deadline_disabled_overhead).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from seaweedfs_tpu.resilience import deadline as deadline_mod
+from seaweedfs_tpu.util.fanout import FanOutPool
+
+# latency samples kept per hedger for the p95 estimate
+_WINDOW = 128
+# recompute the cached p95 every N observations (sorting 128 floats
+# per fetch would be measurable on the hot path)
+_RECALC_EVERY = 16
+
+
+class Hedger:
+    """First-response-wins fetch over ordered candidate thunks."""
+
+    def __init__(self, delay_floor_s: float = 0.010,
+                 budget_pct: float = 0.05, max_inflight: int = 16,
+                 name: str = "hedge"):
+        self.delay_floor_s = delay_floor_s
+        self.budget_pct = budget_pct
+        self.max_inflight = max(2, int(max_inflight))
+        self._pool = FanOutPool(self.max_inflight, name)
+        self._lock = threading.Lock()
+        self._lat: deque = deque(maxlen=_WINDOW)
+        self._since_recalc = 0
+        self._p95 = delay_floor_s
+        # ledger (mirrored in the SeaweedFS_hedge_* families)
+        self.requests = 0
+        self.hedges = 0
+        self.wins = 0
+        self.denied = 0
+        self._inflight = 0
+
+    # -- latency tracking ----------------------------------------------------
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._lat.append(seconds)
+            self._since_recalc += 1
+            if self._since_recalc >= _RECALC_EVERY:
+                self._since_recalc = 0
+                ordered = sorted(self._lat)
+                self._p95 = ordered[int(0.95 * (len(ordered) - 1))]
+
+    def hedge_delay(self) -> float:
+        """How long the primary runs alone: max(tracked p95, floor)."""
+        return max(self._p95, self.delay_floor_s)
+
+    def _budget_ok(self) -> bool:
+        if self.budget_pct <= 0:
+            return False
+        # denominator = EVERY fetch this hedger mediates (including
+        # single-candidate ones): the budget bounds extra LOAD on the
+        # cluster as a fraction of total read traffic, per the Dean &
+        # Barroso framing — not a fraction of hedge-eligible reads.
+        # +1 so the very first slow request may hedge; the pct bound
+        # takes over as volume grows
+        return self.hedges < self.budget_pct * self.requests + 1
+
+    def _acquire_lane(self) -> bool:
+        with self._lock:
+            if self._inflight >= self.max_inflight - 1:
+                return False
+            self._inflight += 1
+            return True
+
+    def _release_lane(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    # -- the fetch -----------------------------------------------------------
+
+    def fetch(self, fns: Sequence[Callable[[], object]],
+              timeout: float = 60.0):
+        """Run fns[0]; after hedge_delay() launch fns[1] when the
+        budget allows; first success wins, remaining attempts are
+        abandoned. A FAILED attempt triggers the next candidate
+        immediately (failover, unbudgeted). Raises the first error
+        once every candidate has failed."""
+        from seaweedfs_tpu.stats.metrics import (HedgeDeniedCounter,
+                                                 HedgeIssuedCounter,
+                                                 HedgeRequestsCounter,
+                                                 HedgeWinsCounter)
+        with self._lock:
+            self.requests += 1
+        HedgeRequestsCounter.inc()
+        rem = deadline_mod.remaining()
+        if rem is not None:
+            if rem <= 0:
+                raise deadline_mod.DeadlineExceeded("hedged fetch")
+            timeout = min(timeout, rem)
+        if len(fns) <= 1 or not self._acquire_lane():
+            # single candidate, or the pool is saturated with
+            # abandoned losers: no hedging, but failover (walking the
+            # candidates on failure) is mandatory work and never
+            # degrades away
+            t0 = time.perf_counter()
+            last_err: Optional[BaseException] = None
+            for i, fn in enumerate(fns):
+                try:
+                    result = fn()
+                except Exception as e:  # noqa: BLE001 - walk candidates
+                    last_err = e
+                    continue
+                if i == 0:
+                    self.observe(time.perf_counter() - t0)
+                return result
+            raise last_err
+
+        def final_error(err: Optional[BaseException]) -> BaseException:
+            # a budget that expired MID-fetch shows up as the timeout
+            # it shrank (RequestTimeout) or as per-candidate refusals;
+            # the caller's contract is DeadlineExceeded either way —
+            # the 504-vs-500 distinction at the server edges rides on
+            # the type
+            if deadline_mod.expired():
+                return deadline_mod.DeadlineExceeded("hedged fetch")
+            return err or TimeoutError("hedged fetch timed out")
+
+        cond = threading.Condition()
+        outcomes: List[tuple] = []   # (idx, result, exc)
+
+        def run(idx: int, fn: Callable):
+            try:
+                r, e = fn(), None
+            except BaseException as exc:  # noqa: BLE001 - latched
+                r, e = None, exc
+            finally:
+                self._release_lane()
+            with cond:
+                outcomes.append((idx, r, e))
+                cond.notify_all()
+
+        t0 = time.perf_counter()
+        end = t0 + timeout
+        self._pool.submit(run, 0, fns[0])
+        launched, hedged, denied_once = 1, False, False
+        hedge_idx = -1   # which launch index was the speculative hedge
+        first_err: Optional[BaseException] = None
+        seen = 0
+        with cond:
+            while True:
+                # consume newly-landed outcomes
+                while seen < len(outcomes):
+                    idx, result, exc = outcomes[seen]
+                    seen += 1
+                    if exc is None:
+                        if idx == hedge_idx:
+                            # only a SPECULATIVE winner is a hedge win;
+                            # a failover winner was mandatory work
+                            with self._lock:
+                                self.wins += 1
+                            HedgeWinsCounter.inc()
+                        elif idx == 0:
+                            self.observe(time.perf_counter() - t0)
+                        return result
+                    if first_err is None:
+                        first_err = exc
+                    if launched < len(fns):
+                        # failover: mandatory, not speculative
+                        if self._acquire_lane():
+                            self._pool.submit(run, launched, fns[launched])
+                            launched += 1
+                        elif seen == launched:
+                            # saturated and nothing else in flight
+                            # (holding cond is safe: no worker of THIS
+                            # fetch remains to contend for it): finish
+                            # the remaining candidates inline, still
+                            # walking on failure
+                            for fn in fns[launched:]:
+                                try:
+                                    return fn()
+                                except Exception as e:  # noqa: BLE001
+                                    if first_err is None:
+                                        first_err = e
+                            raise final_error(first_err)
+                if seen == launched and launched >= len(fns):
+                    raise final_error(first_err)
+                now = time.perf_counter()
+                if now >= end:
+                    raise final_error(first_err)
+                wait = end - now
+                if not hedged and launched < len(fns):
+                    fire_at = t0 + self.hedge_delay()
+                    if now >= fire_at:
+                        if not self._budget_ok():
+                            # only a BUDGET refusal lands in the
+                            # budget-denied counter; a saturated lane
+                            # is a different condition and must not
+                            # read as budget exhaustion on dashboards
+                            if not denied_once:
+                                denied_once = True
+                                with self._lock:
+                                    self.denied += 1
+                                HedgeDeniedCounter.inc()
+                        elif self._acquire_lane():
+                            with self._lock:
+                                self.hedges += 1
+                            HedgeIssuedCounter.inc()
+                            hedge_idx = launched
+                            self._pool.submit(run, launched,
+                                              fns[launched])
+                            launched += 1
+                        hedged = True
+                    else:
+                        wait = min(wait, fire_at - now)
+                cond.wait(timeout=wait)

@@ -18,6 +18,9 @@ from typing import Dict, Optional
 import grpc
 from google.protobuf import message_factory
 
+from seaweedfs_tpu.resilience import deadline as _deadline
+from seaweedfs_tpu.resilience import failpoint as _failpoint
+
 GRPC_PORT_OFFSET = 10000
 
 _channel_lock = threading.Lock()
@@ -98,6 +101,29 @@ def _service_specs(pb2_module, service_name: str):
     return svc, [_MethodSpec(svc, m) for m in svc.methods]
 
 
+def _resilient_call(multicallable, path: str):
+    """Wrap one multicallable with the outbound resilience edge: the
+    rpc.call failpoint and the ambient deadline (capping any caller
+    timeout to the remaining budget; gRPC itself propagates the
+    deadline to the server as context.time_remaining()). Both branches
+    are one flag/contextvar check when disarmed/unbudgeted."""
+    def invoke(request_or_iterator, timeout=None, **kwargs):
+        if _failpoint._armed:
+            _failpoint.hit("rpc.call", method=path)
+        if _deadline.get() is not None:
+            rem = _deadline.remaining()
+            if rem <= 0:
+                from seaweedfs_tpu.stats.metrics import \
+                    DeadlineRefusedCounter
+                DeadlineRefusedCounter.labels("rpc").inc()
+                raise _deadline.DeadlineExceeded(f"rpc {path}")
+            timeout = rem if timeout is None else min(timeout, rem)
+        return multicallable(request_or_iterator, timeout=timeout,
+                             **kwargs)
+    invoke.__name__ = path.rsplit("/", 1)[-1]
+    return invoke
+
+
 def make_stub(pb2_module, service_name: str, target: str):
     """A stub object with one callable per RPC, like codegen'd stubs.
 
@@ -120,10 +146,10 @@ def make_stub(pb2_module, service_name: str, target: str):
             factory = channel.unary_stream
         else:
             factory = channel.unary_unary
-        setattr(stub, spec.name, factory(
+        setattr(stub, spec.name, _resilient_call(factory(
             spec.path,
             request_serializer=spec.req_cls.SerializeToString,
-            response_deserializer=spec.resp_cls.FromString))
+            response_deserializer=spec.resp_cls.FromString), spec.path))
     with _channel_lock:
         return _stub_cache.setdefault(key, stub)
 

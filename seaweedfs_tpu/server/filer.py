@@ -20,6 +20,7 @@ from typing import List, Optional
 import grpc
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.resilience import deadline as _deadline
 from seaweedfs_tpu.util import wlog
 from seaweedfs_tpu.filer import (Filer, FilerError, MemoryStore, NotFound,
                                  SqliteStore, filechunks, stream)
@@ -169,7 +170,9 @@ class FilerServer:
                  peers: Optional[List[str]] = None,
                  store_options: Optional[dict] = None,
                  ingest_parallelism: int = 8,
-                 assign_lease_count: int = 0):
+                 assign_lease_count: int = 0,
+                 hedge_reads: bool = False,
+                 hedge_delay_ms: float = 10.0):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -195,13 +198,22 @@ class FilerServer:
         if assign_lease_count > 1:
             from seaweedfs_tpu.operation.assign_lease import LeaseCache
             self.leases = LeaseCache(count=assign_lease_count)
+        # hedged chunk reads (-resilience.hedge): absent unless enabled
+        # — the disabled read path is one None check; a constructed
+        # Hedger spawns nothing until its first multi-replica fetch
+        self.hedger = None
+        if hedge_reads:
+            from seaweedfs_tpu.resilience import Hedger
+            self.hedger = Hedger(
+                delay_floor_s=max(hedge_delay_ms, 0.1) / 1000.0,
+                name=f"hedge-filer-{port}")
         backend = make_filer_store(store, meta_dir, store_options)
         self.filer = Filer(backend,
                            log_dir=f"{meta_dir}/logs" if meta_dir else None)
         self.filer.on_delete_chunks = self._delete_chunks_async
         self.filer.fetch_chunk_fn = lambda c: stream.fetch_chunk_bytes(
             self.lookup_fid_urls, c.file_id, bytes(c.cipher_key),
-            c.is_compressed)
+            c.is_compressed, hedger=self.hedger)
         self.chunk_cache = TieredChunkCache(
             disk_dir=f"{cache_dir}/chunks" if cache_dir else None)
         from seaweedfs_tpu.rpc import GRPC_PORT_OFFSET
@@ -298,6 +310,12 @@ class FilerServer:
             self._http_server.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.2)
+        # drain the ingest pool and stop banking leases BEFORE closing
+        # the filer store: queued chunk uploads still run, late ones
+        # fall back inline (util/grace shutdown contract)
+        self._ingest_pool.stop()
+        if self.leases is not None:
+            self.leases.close()
         self.filer.close()
 
     # -- helpers --------------------------------------------------------------
@@ -901,7 +919,10 @@ def _make_http_handler(fs: FilerServer):
             try:
                 data = b"".join(stream.stream_content(
                     fs.lookup_fid_urls, list(entry.chunks), offset,
-                    length, cache=fs.chunk_cache))
+                    length, cache=fs.chunk_cache, hedger=fs.hedger))
+            except _deadline.DeadlineExceeded as e:
+                self._json({"error": str(e)}, code=504)
+                return
             except IOError as e:
                 self._json({"error": str(e)}, code=500)
                 return
@@ -968,6 +989,13 @@ def _make_http_handler(fs: FilerServer):
                         mime=mime, fsync=fsync)
                     data_size = len(data)
                 chunks = maybe_manifestize(fs.save_manifest_blob, chunks)
+            except _deadline.DeadlineExceeded as e:
+                # the client's budget ran out mid-ingest: the remaining
+                # chunks were never uploaded, and the 504 says so
+                # before the filer wastes more work on an abandoned body
+                self.close_connection = streaming or self.close_connection
+                self._json({"error": str(e)}, code=504)
+                return
             except (RuntimeError, OSError) as e:
                 # mid-stream failure: part of the body may still sit
                 # unread on the socket

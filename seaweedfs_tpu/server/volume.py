@@ -23,6 +23,9 @@ from urllib.parse import parse_qs
 import grpc
 
 from seaweedfs_tpu import rpc
+from seaweedfs_tpu.resilience import breaker as _breaker
+from seaweedfs_tpu.resilience import deadline as _deadline
+from seaweedfs_tpu.resilience import failpoint as _failpoint
 from seaweedfs_tpu.util import http_client, wlog
 from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
 from seaweedfs_tpu.util.throttler import Throttler
@@ -80,7 +83,9 @@ class VolumeServer:
                  cache_dir: Optional[str] = None,
                  degraded_fleet: bool = True,
                  degraded_batch_ms: float = 2.0,
-                 replicate_parallel: int = 8):
+                 replicate_parallel: int = 8,
+                 hedge_reads: bool = False,
+                 hedge_delay_ms: float = 10.0):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -143,6 +148,15 @@ class VolumeServer:
         self._replicate_pool = FanOutPool(
             max(1, replicate_parallel), f"replicate-{port}")
         self._replica_urls: Dict[int, Tuple[float, List[str]]] = {}
+        # hedged remote shard reads (-resilience.hedge): absent unless
+        # enabled; a constructed Hedger spawns nothing until its first
+        # multi-candidate fetch (resilience house rule)
+        self.hedger = None
+        if hedge_reads:
+            from seaweedfs_tpu.resilience import Hedger
+            self.hedger = Hedger(
+                delay_floor_s=max(hedge_delay_ms, 0.1) / 1000.0,
+                name=f"hedge-volume-{port}")
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -192,6 +206,9 @@ class VolumeServer:
             self._http_server.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.2)
+        # drain in-flight replica fan-outs before the store closes
+        # (util/grace shutdown contract)
+        self._replicate_pool.stop()
         self.store.close()
 
     # -- heartbeat ------------------------------------------------------------
@@ -811,7 +828,7 @@ class VolumeServer:
         record's own stored CRC, so a stale or corrupt replica copy is
         rejected, never written."""
         fid = f"{vid},{corrupt.id:x}{corrupt.cookie:08x}"
-        for url in self._other_replicas(vid):
+        for url in _breaker.sort_candidates(self._other_replicas(vid)):
             try:
                 resp = http_client.request(
                     "GET", f"{url}/{fid}?cm=false",
@@ -896,13 +913,21 @@ class VolumeServer:
 
     def _read_needle(self, vid: int, n: Needle) -> Needle:
         if self.store.has_volume(vid):
-            return self.store.read_needle(vid, n)
-        if self.store.find_ec_volume(vid) is not None:
-            return store_ec.read_ec_needle(
+            got = self.store.read_needle(vid, n)
+        elif self.store.find_ec_volume(vid) is not None:
+            got = store_ec.read_ec_needle(
                 self.store, vid, n,
                 remote_reader=self._make_remote_reader(vid),
                 cache=self.read_cache, decoder=self.degraded)
-        raise NeedleError(f"volume {vid} not found")
+        else:
+            raise NeedleError(f"volume {vid} not found")
+        if _failpoint._armed:
+            # injection site volume.read: delay stalls this server's
+            # reads (the chaos harness's slow-shard scenario), error
+            # fails them, short/corrupt mangle the served payload
+            got.data = _failpoint.mangle(
+                "volume.read", got.data, vid=str(vid), server=self.url)
+        return got
 
     def _delete_needle(self, vid: int, n: Needle) -> int:
         if self.store.has_volume(vid):
@@ -928,27 +953,48 @@ class VolumeServer:
             self.read_cache.invalidate_volume(vid, reason)
 
     def _make_remote_reader(self, vid: int):
+        def fetch_shard(url: str, shard_id: int, offset: int,
+                        length: int) -> bytes:
+            # deadline: a hung peer must fail this row, not pin
+            # the caller (the decode fleet's dispatcher rides
+            # this reader — head-of-line blocking is fatal there)
+            chunks = [r.data for r in volume_stub(url)
+                      .VolumeEcShardRead(
+                          volume_server_pb2.VolumeEcShardReadRequest(
+                              volume_id=vid, shard_id=shard_id,
+                              offset=offset, size=length),
+                          timeout=15)]
+            data = b"".join(chunks)
+            if len(data) != length:
+                raise EcShardNotFound(
+                    f"vid {vid} shard {shard_id}: short remote read")
+            return data
+
         def remote_reader(shard_id: int, offset: int, length: int):
-            tried = False
-            for url in self._ec_shard_locations(vid).get(shard_id, []):
-                if url == self.url:
-                    continue
-                tried = True
+            urls = _breaker.sort_candidates(
+                [u for u in self._ec_shard_locations(vid).get(shard_id, [])
+                 if u != self.url])
+            tried = bool(urls)
+            if self.hedger is not None and len(urls) > 1:
+                # a stalled shard holder hedges to another holder after
+                # the tracked p95; first response wins
                 try:
-                    # deadline: a hung peer must fail this row, not pin
-                    # the caller (the decode fleet's dispatcher rides
-                    # this reader — head-of-line blocking is fatal there)
-                    chunks = [r.data for r in volume_stub(url)
-                              .VolumeEcShardRead(
-                                  volume_server_pb2.VolumeEcShardReadRequest(
-                                      volume_id=vid, shard_id=shard_id,
-                                      offset=offset, size=length),
-                                  timeout=15)]
-                    data = b"".join(chunks)
-                    if len(data) == length:
-                        return data
-                except grpc.RpcError:
-                    continue
+                    return self.hedger.fetch(
+                        [lambda u=u: fetch_shard(u, shard_id, offset,
+                                                 length) for u in urls])
+                except _deadline.DeadlineExceeded:
+                    # a spent budget is the CLIENT's state, not
+                    # evidence against these shard locations — never
+                    # fall into the forget-locations arm below
+                    raise
+                except (grpc.RpcError, OSError, EcShardNotFound):
+                    pass
+            else:
+                for url in urls:
+                    try:
+                        return fetch_shard(url, shard_id, offset, length)
+                    except (grpc.RpcError, EcShardNotFound):
+                        continue
             if tried:
                 # every known location failed: forget THIS shard's
                 # locations so reads stop redialing a dead node
@@ -1039,7 +1085,13 @@ class VolumeServer:
         out with goroutines). Every POST runs to completion — an early
         failure never leaves a sibling's in-flight socket dangling to
         poison the keep-alive pool — then the FIRST error fails the
-        write and forgets the vid's cached locations."""
+        write and forgets the vid's cached locations.
+
+        Open-breaker peers sort last and their POSTs fail fast inside
+        http_client (BreakerOpen) instead of tying a pool lane up for
+        a connect timeout — the write still fails (replication is not
+        optional) but in microseconds, not seconds."""
+        urls = _breaker.sort_candidates(urls)
         from seaweedfs_tpu.stats import trace
         from seaweedfs_tpu.stats.metrics import \
             IngestReplicaFanoutSecondsHistogram
@@ -1241,8 +1293,23 @@ def _make_http_handler(vs: VolumeServer):
                 return
             try:
                 got = vs._read_needle(f.volume_id, n)
+                # a local read that outlived the client's budget (slow
+                # disk, injected stall) must not get a reply the client
+                # stopped waiting for — 504 via the arm below
+                _deadline.check(f"volume {f.volume_id} read")
             except CookieMismatch:
                 self._reply(404)
+                return
+            except _deadline.DeadlineExceeded as e:
+                # the client's budget ran out somewhere down the read
+                # chain (remote shard hop, decode wait): 504, not 404 —
+                # the blob may well exist
+                self._json({"error": str(e)}, code=504)
+                return
+            except _failpoint.FailpointError as e:
+                # injected read failure: surfaces like the IO error it
+                # stands in for
+                self._json({"error": str(e)}, code=500)
                 return
             except DataCorruptionError as e:
                 # corrupt is not missing: a 404 would tell the client
@@ -1283,13 +1350,18 @@ def _make_http_handler(vs: VolumeServer):
             except grpc.RpcError:
                 self._json({"error": "master unreachable"}, code=500)
                 return
-            for vl in resp.volume_id_locations:
-                for loc in vl.locations:
-                    if loc.url != vs.url:
-                        self._reply(302, headers={
-                            "Location": f"http://{loc.public_url or loc.url}"
-                                        f"/{f}"})
-                        return
+            candidates = [loc for vl in resp.volume_id_locations
+                          for loc in vl.locations if loc.url != vs.url]
+            if candidates:
+                # never redirect a client INTO a peer this server
+                # knows is dead when a healthier replica exists
+                loc = min(candidates,
+                          key=lambda l: 1 if _breaker.is_open(l.url)
+                          else 0)
+                self._reply(302, headers={
+                    "Location": f"http://{loc.public_url or loc.url}"
+                                f"/{f}"})
+                return
             self._json({"error": f"volume {f.volume_id} not found"},
                        code=404)
 

@@ -383,6 +383,43 @@ HttpPoolReapedCounter = REGISTRY.counter(
     "SeaweedFS_http_pool_reaped_total",
     "pooled connections closed for exceeding the idle age cap")
 
+# Resilience families (seaweedfs_tpu/resilience/): the failure-handling
+# substrate's ledger — injected faults, breaker state, hedging volume,
+# retry outcomes, and work refused because its deadline was spent.
+FailpointTriggersCounter = REGISTRY.counter(
+    "SeaweedFS_failpoint_triggers_total",
+    "armed failpoints fired", ("site", "action"))
+BreakerStateGauge = REGISTRY.gauge(
+    "SeaweedFS_breaker_state",
+    "circuit breaker state per peer (0 closed, 1 half-open, 2 open)",
+    ("peer",))
+BreakerTransitionsCounter = REGISTRY.counter(
+    "SeaweedFS_breaker_transitions_total",
+    "circuit breaker state transitions", ("peer", "to"))
+HedgeRequestsCounter = REGISTRY.counter(
+    "SeaweedFS_hedge_requests_total",
+    "hedge-eligible fetches (the budget denominator)")
+HedgeIssuedCounter = REGISTRY.counter(
+    "SeaweedFS_hedge_issued_total",
+    "speculative second requests actually sent")
+HedgeWinsCounter = REGISTRY.counter(
+    "SeaweedFS_hedge_wins_total",
+    "fetches where the hedge answered before the primary")
+HedgeDeniedCounter = REGISTRY.counter(
+    "SeaweedFS_hedge_budget_denied_total",
+    "hedges withheld because the <=budget_pct extra-request cap "
+    "was spent")
+RetryAttemptsCounter = REGISTRY.counter(
+    "SeaweedFS_retry_attempts_total",
+    "retry attempts by outcome", ("name", "outcome"))
+MasterReconnectsCounter = REGISTRY.counter(
+    "SeaweedFS_master_reconnects_total",
+    "master client stream redials after a break")
+DeadlineRefusedCounter = REGISTRY.counter(
+    "SeaweedFS_deadline_refused_total",
+    "work refused because the request's budget was already spent",
+    ("where",))
+
 
 # -- shared request instrumentation -------------------------------------------
 #
@@ -397,7 +434,14 @@ def instrument_http_handler(handler_cls, role: str):
     with the request counter + latency histogram (+ a trace span when
     tracing is on). Wraps the do_* dispatch, not handle_one_request, so
     keep-alive idle time between requests is never measured as request
-    latency. Returns the class for chaining."""
+    latency. Returns the class for chaining.
+
+    Also the single deadline-ingress point for HTTP: a request carrying
+    X-Seaweed-Deadline has its remaining budget re-anchored into the
+    handler thread's contextvar, so every outbound hop the handler
+    makes (pooled HTTP, gRPC, retries) inherits the shrinking budget.
+    Requests without the header pay one dict lookup."""
+    from seaweedfs_tpu.resilience import deadline as deadline_mod
     from seaweedfs_tpu.stats import trace
 
     def _wrap(methname):
@@ -409,6 +453,12 @@ def instrument_http_handler(handler_cls, role: str):
 
         def wrapped(self):
             t0 = time.perf_counter()
+            token = None
+            hdr = self.headers.get(deadline_mod.HEADER_LOWER)
+            if hdr is not None:
+                rem = deadline_mod.parse_header(hdr)
+                if rem is not None:
+                    token = deadline_mod.set_budget(rem)
             sp = trace.span(span_name, path=self.path) \
                 if trace.is_enabled() else trace.NOOP
             sp.__enter__()
@@ -416,6 +466,8 @@ def instrument_http_handler(handler_cls, role: str):
                 orig(self)
             finally:
                 sp.__exit__(None, None, None)
+                if token is not None:
+                    deadline_mod.reset(token)
                 counter.inc()
                 histogram.observe(time.perf_counter() - t0)
         wrapped.__name__ = methname
@@ -436,7 +488,13 @@ def instrument_grpc_method(fn, role: str, method_name: str,
     histogram or span: streams can live for the process lifetime
     (SendHeartbeat, SubscribeMetadata), so an end-of-stream observation
     would report nothing while the cluster runs and then poison
-    _sum/_count with one hours-long sample at shutdown."""
+    _sum/_count with one hours-long sample at shutdown.
+
+    Unary methods are also the deadline-ingress point for gRPC: the
+    caller's deadline (context.time_remaining()) re-anchors into the
+    handler thread's contextvar so downstream hops inherit the budget
+    (streams are exempt — they live for the process lifetime)."""
+    from seaweedfs_tpu.resilience import deadline as deadline_mod
     from seaweedfs_tpu.stats import trace
     counter = RequestCounter.labels(role, method_name)
     histogram = RequestHistogram.labels(role, method_name)
@@ -449,12 +507,23 @@ def instrument_grpc_method(fn, role: str, method_name: str,
     else:
         def wrapped(request, context):
             t0 = time.perf_counter()
+            token = None
+            rem = context.time_remaining()
+            # no-deadline calls report None OR int64-max seconds
+            # depending on grpc version; only a real budget (< a year)
+            # is worth anchoring — and feeding the int64 sentinel back
+            # into an outbound timeout would overflow grpc's deadline
+            # math into an instant DEADLINE_EXCEEDED
+            if rem is not None and rem < 86400.0 * 365:
+                token = deadline_mod.set_budget(rem)
             sp = trace.span(span_name) if trace.is_enabled() else trace.NOOP
             sp.__enter__()
             try:
                 return fn(request, context)
             finally:
                 sp.__exit__(None, None, None)
+                if token is not None:
+                    deadline_mod.reset(token)
                 counter.inc()
                 histogram.observe(time.perf_counter() - t0)
     wrapped.__name__ = method_name
@@ -464,12 +533,15 @@ def instrument_grpc_method(fn, role: str, method_name: str,
 def start_metrics_server(port: int, registry: Registry = REGISTRY,
                          ip: str = "", role: str = "") -> ThreadingHTTPServer:
     """Serve GET /metrics (Prometheus text), GET /healthz (role +
-    uptime JSON, the readiness probe tests/cluster_util.py polls) and
-    GET /debug/trace (Chrome trace-event JSON of the span ring). Any
-    other path is 404 — the handler defines only do_GET, so non-GET
-    methods get the stock 501."""
+    uptime JSON, the readiness probe tests/cluster_util.py polls),
+    GET /debug/trace (Chrome trace-event JSON of the span ring) and
+    GET|POST /debug/failpoint (the fault-injection control plane:
+    GET lists the armed table, POST arms/disarms — see
+    resilience/failpoint.py for the JSON body). Any other path is 404;
+    other methods get the stock 501."""
     import json as _json
 
+    from seaweedfs_tpu.resilience import failpoint
     from seaweedfs_tpu.stats import trace
 
     started = time.time()
@@ -489,6 +561,9 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
             elif path == "/debug/trace":
                 body = trace.chrome_trace_json().encode()
                 ctype = "application/json"
+            elif path == "/debug/failpoint":
+                body = _json.dumps(failpoint.active()).encode()
+                ctype = "application/json"
             else:
                 body = b"404 not found\n"
                 self.send_response(404)
@@ -499,6 +574,46 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
                 return
             self.send_response(200)
             self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            path = self.path.partition("?")[0]
+            if path != "/debug/failpoint":
+                self._answer(404, {"error": "not found"})
+                return
+            if not failpoint.http_control_enabled():
+                # fault injection over the network needs the process's
+                # explicit opt-in (SEAWEED_FAILPOINTS, even just "on")
+                self._answer(403, {"error":
+                                   "failpoint control disabled; set "
+                                   "SEAWEED_FAILPOINTS to enable"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                req = _json.loads(self.rfile.read(n) or b"{}")
+                action = req.get("action", "")
+                if action == "reset":
+                    failpoint.disarm()
+                elif action == "off":
+                    failpoint.disarm(req["site"])
+                else:
+                    failpoint.arm(
+                        req["site"], action,
+                        arg=float(req.get("arg", 0.0)),
+                        p=float(req.get("p", 1.0)),
+                        count=req.get("count"),
+                        match=req.get("match"))
+            except (KeyError, TypeError, ValueError) as e:
+                self._answer(400, {"error": str(e)})
+                return
+            self._answer(200, failpoint.active())
+
+        def _answer(self, code: int, payload) -> None:
+            body = _json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -26,6 +26,8 @@ import os
 import threading
 from typing import Callable, Dict, Optional
 
+from seaweedfs_tpu.resilience import failpoint as _failpoint
+
 
 class BackendError(Exception):
     pass
@@ -85,6 +87,12 @@ class DiskFile(BackendStorageFile):
         # pwrite may return a short count (e.g. ENOSPC mid-write); loop
         # so callers get all-or-exception — the volume's
         # truncate-on-error path depends on partial writes raising
+        if _failpoint._armed:
+            # injected torn write (short), bit flip (corrupt), EIO
+            # (error) or stall (delay) — the scrub/crash tests' way of
+            # making disk failure modes happen on demand
+            data = _failpoint.mangle("backend.write_at", data,
+                                     path=self._path)
         view = memoryview(bytes(data) if not isinstance(
             data, (bytes, bytearray, memoryview)) else data)
         total = len(view)

@@ -18,6 +18,7 @@ replicated write never grows an ingest thread.
 
 from __future__ import annotations
 
+import contextvars
 import queue
 import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
@@ -62,39 +63,76 @@ class FanOutPool:
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
+        self._stopping = False
 
     def thread_count(self) -> int:
         return len(self._threads)
 
-    def _maybe_spawn(self) -> None:
-        with self._lock:
-            if len(self._threads) >= self.size:
-                return
-            t = threading.Thread(
-                target=self._worker, daemon=True,
-                name=f"{self.name}-{len(self._threads)}")
-            self._threads.append(t)
-        t.start()
-
     def _worker(self) -> None:
         while True:
-            fut, fn, args = self._q.get()
-            try:
-                fut.result = fn(*args)
-            except BaseException as e:  # noqa: BLE001 - latched, not lost
-                fut.exc = e
-            finally:
-                if self._inflight_gauge is not None:
-                    self._inflight_gauge.dec()
-                fut._ev.set()
+            item = self._q.get()
+            if item is None:   # stop() sentinel
+                return
+            fut, ctx, fn, args = item
+            self._run_task(fut, ctx, fn, args)
+
+    def _run_task(self, fut: Future, ctx, fn: Callable, args) -> None:
+        try:
+            fut.result = ctx.run(fn, *args)
+        except BaseException as e:  # noqa: BLE001 - latched, not lost
+            fut.exc = e
+        finally:
+            if self._inflight_gauge is not None:
+                self._inflight_gauge.dec()
+            fut._ev.set()
 
     def submit(self, fn: Callable, *args) -> Future:
+        # tasks run in a COPY of the submitter's context, so ambient
+        # request state — the resilience deadline above all — follows
+        # the work across the thread hop instead of silently resetting
+        ctx = contextvars.copy_context()
         fut = Future()
         if self._inflight_gauge is not None:
             self._inflight_gauge.inc()
-        self._maybe_spawn()
-        self._q.put((fut, fn, args))
+        # enqueue + stopping-check + spawn-bookkeeping are one atomic
+        # step against stop(): a task enqueued under the lock is
+        # guaranteed to sit AHEAD of stop()'s sentinels (stop takes the
+        # same lock first), so it always gets a worker; a submit that
+        # sees _stopping runs inline instead — no window where a task
+        # lands behind the sentinels and hangs its Future forever
+        with self._lock:
+            stopping = self._stopping
+            if not stopping:
+                self._q.put((fut, ctx, fn, args))
+                if len(self._threads) < self.size:
+                    t = threading.Thread(
+                        target=self._worker, daemon=True,
+                        name=f"{self.name}-{len(self._threads)}")
+                    # started INSIDE the lock: stop() joins whatever
+                    # sits in _threads, and joining a never-started
+                    # thread raises RuntimeError mid-shutdown
+                    t.start()
+                    self._threads.append(t)
+        if stopping:
+            # drain semantics after stop(): late tasks run inline on
+            # the caller instead of being lost or growing new threads
+            self._run_task(fut, ctx, fn, args)
         return fut
+
+    def stop(self, join_timeout: float = 2.0) -> None:
+        """Drain + stop every worker (util/grace shutdown path: server
+        stop() calls this). Queued tasks still run — workers only exit
+        on the sentinel, which sits BEHIND everything already queued —
+        and tasks submitted afterwards run inline on the caller."""
+        with self._lock:
+            if self._stopping:
+                return
+            self._stopping = True
+            threads = list(self._threads)
+        for _ in threads:
+            self._q.put(None)
+        for t in threads:
+            t.join(timeout=join_timeout)
 
     def run(self, fns: Sequence[Callable]
             ) -> List[Tuple[Any, Optional[BaseException]]]:

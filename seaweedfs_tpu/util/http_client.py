@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from seaweedfs_tpu.resilience import breaker, deadline, failpoint
 from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
 
 _pool_lock = threading.Lock()
@@ -58,11 +59,29 @@ def _export_pool_gauge() -> None:
 _export_pool_gauge()
 
 
+class ConnectError(OSError):
+    """Could not establish (or reuse) a connection — the request never
+    reached the peer, so replaying it is always safe. The class the
+    retry default classifier treats as retryable."""
+
+
+class ResponseError(OSError):
+    """Wire failure AFTER the request was sent: the peer may have
+    executed it, so blind replay is not safe."""
+
+
+class RequestTimeout(ResponseError):
+    """Timed out awaiting the peer (connect timeouts surface as
+    ConnectError via create_connection instead)."""
+
+
 class _Conn:
     __slots__ = ("netloc", "sock", "rfile", "last_used")
 
     def __init__(self, netloc: str, timeout: float):
         self.netloc = netloc
+        if failpoint._armed:
+            failpoint.hit("http.connect", peer=netloc)
         if netloc.startswith("["):  # [v6-literal]:port or bare [v6-literal]
             bracket = netloc.find("]")
             host = netloc[1:bracket]
@@ -73,8 +92,11 @@ class _Conn:
             port = int(port_s)
         else:
             host, port = netloc, 80
-        self.sock = socket.create_connection((host, port),
-                                             timeout=timeout)
+        try:
+            self.sock = socket.create_connection((host, port),
+                                                 timeout=timeout)
+        except OSError as e:
+            raise ConnectError(f"connect {netloc}: {e}") from e
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.rfile = self.sock.makefile("rb", buffering=65536)
         self.last_used = time.monotonic()
@@ -166,8 +188,57 @@ def request(method: str, url: str, body: Optional[bytes] = None,
 
     `url` is "http://host:port/path?q" or bare "host:port/path?q".
     Returns the full body bytes.
+
+    Resilience edge (each branch is one flag check when disabled):
+      - an ambient deadline refuses exhausted budgets up front, sizes
+        the socket timeout to min(timeout, remaining), and forwards
+        the remaining budget in X-Seaweed-Deadline
+      - an enabled circuit breaker fails fast on an open peer and is
+        fed by this call's final outcome (any HTTP response counts as
+        peer-alive; only connection-level OSError counts as failure)
+      - the http.connect / http.response failpoints inject here
     """
     netloc, path = _split(url)
+    budget_shrunk = False
+    if deadline.get() is not None:
+        rem = deadline.remaining()
+        if rem <= 0:
+            from seaweedfs_tpu.stats.metrics import DeadlineRefusedCounter
+            DeadlineRefusedCounter.labels("http_client").inc()
+            raise deadline.DeadlineExceeded(f"{method} {netloc}{path}")
+        if rem < timeout:
+            timeout = rem
+            budget_shrunk = True
+        merged = dict(headers) if headers else {}
+        merged[deadline.HEADER] = f"{rem:.4f}"
+        headers = merged
+    if breaker.enabled:
+        breaker.check(netloc)   # raises BreakerOpen while open
+    try:
+        resp = _request_once_retried(netloc, path, method, body, headers,
+                                     timeout, pooled)
+    except deadline.DeadlineExceeded:
+        # a spent budget says nothing about the PEER's health
+        raise
+    except OSError as e:
+        # ...and neither does a timeout the budget SHRANK below the
+        # caller's own: a healthy-but-slower-than-the-budget peer must
+        # not have its breaker opened by impatient clients
+        if breaker.enabled and not (budget_shrunk and
+                                    isinstance(e, RequestTimeout)):
+            breaker.record(netloc, False)
+        raise
+    if breaker.enabled:
+        breaker.record(netloc, True)
+    if failpoint._armed:
+        resp.body = failpoint.mangle("http.response", resp.body,
+                                     peer=netloc, status=str(resp.status))
+    return resp
+
+
+def _request_once_retried(netloc: str, path: str, method: str,
+                          body: Optional[bytes], headers: Optional[dict],
+                          timeout: float, pooled: bool) -> Response:
     reuse_ok = pooled
     for attempt in (0, 1):
         if reuse_ok:
@@ -192,6 +263,12 @@ def request(method: str, url: str, body: Optional[bytes] = None,
             HttpPoolStaleRetryCounter.inc()
             reuse_ok = False
             continue
+        except TimeoutError as e:
+            # typed for retry classification: the peer may have run the
+            # request, so this is never blind-replayed
+            conn.close()
+            raise RequestTimeout(
+                f"{method} {netloc}{path}: {e or 'timed out'}") from e
         except OSError:
             conn.close()
             raise
@@ -203,7 +280,32 @@ def request(method: str, url: str, body: Optional[bytes] = None,
     raise RuntimeError("unreachable")
 
 
-class _StaleConnection(OSError):
+def classify(exc: BaseException) -> str:
+    """Bucket a data-plane client error for retry decisions and
+    metrics: 'deadline' | 'breaker' | 'timeout' | 'connect' |
+    'response' | 'other'."""
+    if isinstance(exc, deadline.DeadlineExceeded):
+        return "deadline"
+    if isinstance(exc, breaker.BreakerOpen):
+        return "breaker"
+    if isinstance(exc, (RequestTimeout, TimeoutError)):
+        return "timeout"
+    if isinstance(exc, ConnectError):
+        return "connect"
+    if isinstance(exc, _StaleConnection) and exc.retryable:
+        # retryable=True is the class's own contract that no byte
+        # reached the peer — connect-class, safe to replay
+        return "connect"
+    if isinstance(exc, ResponseError):
+        return "response"
+    if isinstance(exc, OSError):
+        # raw socket errors surface at connect/reuse time; post-send
+        # failures are wrapped in _StaleConnection/RequestTimeout above
+        return "connect"
+    return "other"
+
+
+class _StaleConnection(ResponseError):
     """Connection-level failure. retryable=True means no response byte
     arrived AND the request cannot have been durably received (safe to
     replay on a fresh connection). Subclasses OSError so callers'

@@ -1,9 +1,29 @@
-"""Waterfall retry with backoff (reference: weed/util/retry.go)."""
+"""Waterfall retry with full jitter, deadline cap, and typed outcomes
+(reference: weed/util/retry.go, grown per "The Tail at Scale": naked
+exponential backoff synchronizes retry storms; full jitter — U(0, wait)
+— decorrelates them, and a total deadline stops retrying work the
+caller has already abandoned).
+
+Every attempt lands in SeaweedFS_retry_attempts_total{name,outcome}:
+  ok            the attempt succeeded
+  retried       the attempt failed and another follows
+  exhausted     the attempt failed and the attempt budget is spent
+  nonretryable  the error class must not be replayed
+  deadline      the time budget ran out before another attempt fit
+
+The default `retryable=` is no longer a catch-all: it classifies via
+util/http_client.classify — connection-class errors (the request never
+reached the peer) retry; timeouts and post-send response errors do NOT
+(the peer may have executed the request); open breakers and spent
+deadlines never burn attempts. Non-HTTP exceptions stay retryable,
+preserving the old behavior for generic callers.
+"""
 
 from __future__ import annotations
 
+import random
 import time
-from typing import Callable, TypeVar
+from typing import Callable, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -12,20 +32,70 @@ class NonRetryableError(Exception):
     pass
 
 
+def default_retryable(e: Exception) -> bool:
+    from seaweedfs_tpu.util import http_client
+    return http_client.classify(e) in ("connect", "other")
+
+
+def _count(name: str, outcome: str) -> None:
+    from seaweedfs_tpu.stats.metrics import RetryAttemptsCounter
+    RetryAttemptsCounter.labels(name, outcome).inc()
+
+
 def retry(name: str, fn: Callable[[], T], *, times: int = 6,
           wait_seconds: float = 0.05, backoff: float = 2.0,
-          retryable: Callable[[Exception], bool] = lambda e: True) -> T:
+          retryable: Optional[Callable[[Exception], bool]] = None,
+          deadline: Optional[float] = None, jitter: bool = True,
+          _sleep=time.sleep, _rand=random.random) -> T:
+    """Run fn() up to `times` times with full-jitter exponential
+    backoff (sleep_k ~ U(0, wait_seconds * backoff**k) when jitter).
+
+    `deadline` caps the WHOLE call in seconds; it combines (min) with
+    any ambient resilience deadline, sleeps truncate to the remaining
+    budget, and a spent budget stops retrying immediately. A budget
+    that is already spent at entry raises DeadlineExceeded without
+    running fn at all — the caller is gone, the work is garbage.
+    """
+    from seaweedfs_tpu.resilience import deadline as dl
+    if retryable is None:
+        retryable = default_retryable
+    budget_end = None
+    if deadline is not None:
+        budget_end = time.monotonic() + deadline
+    ambient = dl.get()
+    if ambient is not None:
+        budget_end = ambient if budget_end is None \
+            else min(budget_end, ambient)
+    if budget_end is not None and time.monotonic() >= budget_end:
+        _count(name, "deadline")
+        raise dl.DeadlineExceeded(f"retry {name}")
+
     wait = wait_seconds
     last: Exception = RuntimeError(f"{name}: retry never ran")
     for attempt in range(times):
         try:
-            return fn()
+            result = fn()
+            _count(name, "ok")
+            return result
         except NonRetryableError:
+            _count(name, "nonretryable")
             raise
-        except Exception as e:  # noqa: BLE001 - deliberate catch-all retry
+        except Exception as e:  # noqa: BLE001 - classified below
             last = e
-            if not retryable(e) or attempt == times - 1:
+            if not retryable(e):
+                _count(name, "nonretryable")
                 break
-            time.sleep(wait)
+            if attempt == times - 1:
+                _count(name, "exhausted")
+                break
+            pause = _rand() * wait if jitter else wait
+            if budget_end is not None:
+                remaining = budget_end - time.monotonic()
+                if remaining <= 0:
+                    _count(name, "deadline")
+                    break
+                pause = min(pause, remaining)
+            _count(name, "retried")
+            _sleep(pause)
             wait *= backoff
     raise last

@@ -3,11 +3,20 @@
 Holds a background KeepConnected stream to the master; deltas keep the
 VidMap fresh so data-path clients never block on /dir/lookup.
 
+Reconnect discipline: the pre-resilience loop hammered the configured
+masters in a tight 0.5 s rotation — a leaderless election window
+turned every client into extra election load. Now each full failed
+rotation backs off exponentially with FULL jitter (U(0, wait), wait
+doubling to a 5 s cap), resets on any established stream, and counts
+redials in SeaweedFS_master_reconnects_total. With breakers enabled a
+master that refuses streams repeatedly is skipped until its cooldown.
+
 Reference: weed/wdclient/masterclient.go:16-160.
 """
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import List, Optional
@@ -15,7 +24,22 @@ from typing import List, Optional
 import grpc
 
 from seaweedfs_tpu.pb import master_pb2, master_stub
+from seaweedfs_tpu.resilience import breaker
 from seaweedfs_tpu.wdclient.vid_map import Location, VidMap
+
+RECONNECT_WAIT_S = 0.2     # first backoff step after a failed rotation
+RECONNECT_WAIT_CAP_S = 5.0
+
+
+class MasterUnreachable(TimeoutError):
+    """No configured master produced a KeepConnected stream in time.
+    Subclasses TimeoutError so pre-existing callers keep catching it."""
+
+    def __init__(self, masters: List[str], timeout: float):
+        super().__init__(
+            f"no master reachable within {timeout:.1f}s "
+            f"(tried {', '.join(masters)})")
+        self.masters = list(masters)
 
 
 class MasterClient:
@@ -28,10 +52,12 @@ class MasterClient:
         self.grpc_port = grpc_port  # advertised via ListMasterClients
         self.current_master = masters[0]
         self.vid_map = VidMap()
+        self.reconnects = 0   # redials after the initial dial (ledger)
         self._stop = threading.Event()
         self._ready = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stream = None
+        self._dialed = False
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -44,7 +70,7 @@ class MasterClient:
 
     def wait_until_connected(self, timeout: float = 10.0) -> None:
         if not self._ready.wait(timeout):
-            raise TimeoutError("master KeepConnected never came up")
+            raise MasterUnreachable(self.masters, timeout)
 
     def stop(self) -> None:
         self._stop.set()
@@ -54,33 +80,75 @@ class MasterClient:
     # -- stream --------------------------------------------------------------
 
     def _keep_connected_loop(self) -> None:
+        wait = RECONNECT_WAIT_S
         while not self._stop.is_set():
+            progressed = False
             for target in [self.current_master] + \
                     [m for m in self.masters if m != self.current_master]:
                 if self._stop.is_set():
                     return
+                if breaker.enabled and target != self.current_master:
+                    # skip a master whose breaker is open — EXCEPT the
+                    # current one, which stays the half-open probe path
+                    if breaker.is_open(target):
+                        continue
                 try:
-                    self._follow(target)
-                except grpc.RpcError:
-                    continue
-            time.sleep(0.5)
-
-    def _follow(self, target: str) -> None:
-        stub = master_stub(target)
-        self._stream = stub.KeepConnected(iter(
-            [master_pb2.KeepConnectedRequest(name=self.client_name,
-                                             grpc_port=self.grpc_port)]))
-        for loc in self._stream:
+                    breaker.check(target)
+                except breaker.BreakerOpen:
+                    continue   # a refusal is not evidence of failure
+                if self._follow(target):
+                    progressed = True
             if self._stop.is_set():
                 return
-            self.current_master = target
-            if loc.leader and loc.leader != target:
-                # not the leader: reconnect there next
-                self.current_master = loc.leader
-                self._stream.cancel()
-                return
-            self._apply(loc)
-            self._ready.set()
+            if progressed:
+                wait = RECONNECT_WAIT_S
+                continue
+            # full rotation failed: full-jitter exponential backoff so
+            # a fleet of clients does not synchronize on the masters
+            self._stop.wait(timeout=random.random() * wait)
+            wait = min(wait * 2, RECONNECT_WAIT_CAP_S)
+
+    def _follow(self, target: str) -> bool:
+        """One KeepConnected stream's lifetime. Returns True when the
+        stream established (>= 1 message), i.e. the redial backoff
+        should reset. Never raises — ANY failure here (grpc, an armed
+        rpc.call failpoint's OSError, anything) must cost one rotation
+        step, never the keep-connected thread itself."""
+        if self._dialed:
+            self.reconnects += 1
+            from seaweedfs_tpu.stats.metrics import MasterReconnectsCounter
+            MasterReconnectsCounter.inc()
+        self._dialed = True
+        established = False
+        try:
+            stub = master_stub(target)
+            self._stream = stub.KeepConnected(iter(
+                [master_pb2.KeepConnectedRequest(name=self.client_name,
+                                                 grpc_port=self.grpc_port)]))
+            for loc in self._stream:
+                if not established:
+                    established = True
+                    breaker.record(target, True)
+                if self._stop.is_set():
+                    return established
+                self.current_master = target
+                if loc.leader and loc.leader != target:
+                    # not the leader: reconnect there next
+                    self.current_master = loc.leader
+                    self._stream.cancel()
+                    return established
+                self._apply(loc)
+                self._ready.set()
+        except Exception:  # noqa: BLE001 - see docstring
+            pass
+        # a stream that BROKE after establishing is not a dead master;
+        # a dial that never produced a message — whether it raised or
+        # closed cleanly empty — is, and MUST be recorded: breaker
+        # half-open probes are reclaimed by record(), so an unrecorded
+        # probe would wedge the peer's breaker
+        if not established:
+            breaker.record(target, False)
+        return established
 
     def _apply(self, loc: master_pb2.VolumeLocation) -> None:
         if loc.url:

@@ -11,7 +11,15 @@ from seaweedfs_tpu.util.cpu_mesh import force_cpu_platform
 force_cpu_platform(8)
 
 
+import threading
+
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running case excluded from tier-1 "
+        "(-m 'not slow')")
 
 
 def pytest_collection_modifyitems(items):
@@ -23,6 +31,34 @@ def pytest_collection_modifyitems(items):
     heavy = [it for it in items if "test_parallel" in it.nodeid]
     rest = [it for it in items if "test_parallel" not in it.nodeid]
     items[:] = heavy + rest
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Graceful-shutdown audit (ISSUE 6 satellite): any test that
+    leaves a NON-daemon thread running would block interpreter exit.
+    Daemon threads (every pool/daemon in this tree) and
+    concurrent.futures executor workers (joined by the stdlib's atexit
+    hook after sentinel delivery, so they never hang the process) are
+    exempt; everything else must be gone — after a short join grace
+    for threads still winding down — or the test fails by name."""
+    import concurrent.futures.thread as cft
+    before = set(threading.enumerate())
+    yield
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.is_alive() and not t.daemon
+                and t is not threading.current_thread()
+                and t not in before
+                and t not in cft._threads_queues]
+
+    offenders = leaked()
+    for t in offenders:
+        t.join(timeout=2.0)
+    offenders = leaked()
+    assert not offenders, \
+        f"test leaked non-daemon threads: {[t.name for t in offenders]}"
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -475,6 +475,82 @@ def test_ingest_pipeline_disabled_overhead(tmp_path, monkeypatch):
     fs.filer.close()
 
 
+def test_failpoints_disabled_overhead():
+    """Failpoints must compile to a zero-cost no-op when unarmed
+    (ISSUE 6 tentpole contract, the tracing-disabled twin for fault
+    injection).
+
+    The call-site pattern is `if failpoint._armed: failpoint.hit(...)`
+    — one module-attribute truth test on the hot path. 200k iterations
+    of exactly that pattern must stay far under a microsecond each
+    (measured ~0.05 us; the 2 us ceiling only catches the regression
+    class where a site accidentally calls into the spec table while
+    unarmed). Arming and disarming must restore the zero-cost state."""
+    from seaweedfs_tpu.resilience import failpoint
+
+    assert not failpoint._armed, \
+        "failpoints must be unarmed by default (no SEAWEED_FAILPOINTS)"
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if failpoint._armed:
+            failpoint.hit("gate.site", peer="x")
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 2e-6, \
+        f"unarmed failpoint check costs {per_call * 1e6:.3f} us/call"
+
+    failpoint.arm("gate.site", "delay", arg=0.0)
+    assert failpoint._armed
+    failpoint.disarm()
+    assert not failpoint._armed, "disarm must restore the zero-cost state"
+
+
+def test_breaker_hedge_deadline_disabled_overhead(tmp_path):
+    """Breakers, hedging, and deadline propagation must be zero-cost
+    while disabled/unbudgeted (ISSUE 6 contract).
+
+    Defaults: breakers off (module flag), hedging absent (servers hold
+    hedger=None unless -resilience.hedge), deadlines unset (contextvar
+    None). The per-request tax of the disabled layer is one flag check
+    plus one ContextVar.get(); 200k iterations of that combined check
+    hold a generous 2 us ceiling. Construction: a Hedger spawns no
+    threads until its first multi-candidate fetch."""
+    import threading
+
+    from seaweedfs_tpu.resilience import Hedger, breaker, deadline
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    assert not breaker.enabled, "breakers must be off by default"
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if deadline.get() is not None:
+            raise AssertionError("no ambient deadline expected")
+        if breaker.enabled:
+            breaker.check("x")
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 2e-6, \
+        f"disabled breaker+deadline check costs {per_call * 1e6:.3f} us"
+
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)])
+    assert vs.hedger is None, \
+        "default-config volume server must not construct a hedger"
+    vs.store.close()
+    fs = FilerServer(master_url="127.0.0.1:1", port=38889)
+    assert fs.hedger is None, \
+        "default-config filer must not construct a hedger"
+    fs.filer.close()
+
+    before = {t.name for t in threading.enumerate()}
+    h = Hedger(name="gate-hedge")
+    assert {t.name for t in threading.enumerate()} == before, \
+        "constructing a hedger must not spawn threads"
+    assert h.fetch([lambda: 42]) == 42   # single-candidate: inline
+    assert {t.name for t in threading.enumerate()} == before, \
+        "single-candidate fetches must stay on the caller thread"
+
+
 def test_scrub_disabled_overhead(tmp_path):
     """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
     test_tracing_disabled_overhead twin for the integrity subsystem).

@@ -1,0 +1,602 @@
+"""Unit tests for the resilience substrate (ISSUE 6): failpoints,
+deadline propagation, per-peer circuit breakers, hedged reads, the
+jittered/deadline-capped retry, and the graceful-shutdown plumbing."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.resilience import (BreakerOpen, DeadlineExceeded,
+                                      FailpointError, Hedger, breaker,
+                                      deadline, failpoint)
+from seaweedfs_tpu.util import http_client
+from seaweedfs_tpu.util.fanout import FanOutPool
+from seaweedfs_tpu.util.retry import NonRetryableError, retry
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Failpoints and breakers are process-global by design (that is
+    how servers in one process share them); tests must never leak
+    armed state into the rest of the suite."""
+    yield
+    failpoint.disarm()
+    breaker.reset()
+
+
+# -- failpoints ---------------------------------------------------------------
+
+
+class TestFailpoint:
+    def test_unarmed_is_flag_only(self):
+        assert not failpoint._armed
+        # the call-site contract: sites do nothing without the flag
+        failpoint.hit("nothing.armed", peer="x")
+        assert failpoint.mangle("nothing.armed", b"data") == b"data"
+
+    def test_error_action_raises_oserror(self):
+        failpoint.arm("a.site", "error")
+        assert failpoint._armed
+        with pytest.raises(FailpointError) as ei:
+            failpoint.hit("a.site")
+        assert isinstance(ei.value, OSError)
+        failpoint.disarm("a.site")
+        assert not failpoint._armed
+
+    def test_delay_action_sleeps(self):
+        failpoint.arm("a.site", "delay", arg=0.05)
+        t0 = time.monotonic()
+        failpoint.hit("a.site")
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_short_and_corrupt_mangle_data(self):
+        failpoint.arm("data.site", "short", arg=3)
+        assert failpoint.mangle("data.site", b"abcdefgh") == b"abcde"
+        failpoint.disarm()
+        failpoint.arm("data.site", "corrupt")
+        out = failpoint.mangle("data.site", b"abcdefgh")
+        assert len(out) == 8 and out != b"abcdefgh"
+
+    def test_count_limited(self):
+        failpoint.arm("a.site", "error", count=2)
+        for _ in range(2):
+            with pytest.raises(FailpointError):
+                failpoint.hit("a.site")
+        failpoint.hit("a.site")   # spent: no longer fires
+
+    def test_probability_zero_never_fires(self):
+        failpoint.arm("a.site", "error", p=0.0)
+        for _ in range(50):
+            failpoint.hit("a.site")
+
+    def test_label_match_is_substring(self):
+        failpoint.arm("a.site", "error", match={"peer": ":8081"})
+        failpoint.hit("a.site", peer="127.0.0.1:8080")   # no match
+        with pytest.raises(FailpointError):
+            failpoint.hit("a.site", peer="127.0.0.1:8081")
+        # missing label never matches
+        failpoint.hit("a.site")
+
+    def test_env_grammar(self):
+        failpoint.arm_from_string(
+            "a.b{peer=:8080}=delay(0.5)@0.25*3 ; c.d=corrupt")
+        table = {s["site"]: s for s in failpoint.active()}
+        assert table["a.b"]["action"] == "delay"
+        assert table["a.b"]["arg"] == 0.5
+        assert table["a.b"]["p"] == 0.25
+        assert table["a.b"]["count"] == 3
+        assert table["a.b"]["match"] == {"peer": ":8080"}
+        assert table["c.d"]["action"] == "corrupt"
+        # off entries disarm their site
+        failpoint.arm_from_string("c.d=off")
+        assert "c.d" not in {s["site"] for s in failpoint.active()}
+
+    def test_env_grammar_rejects_junk(self):
+        with pytest.raises(ValueError):
+            failpoint.arm_from_string("no-equals-sign")
+        with pytest.raises(ValueError):
+            failpoint.arm_from_string("a.b=explode")
+
+    def test_http_client_connect_site(self):
+        failpoint.arm("http.connect", "error",
+                      match={"peer": "256.0.0.1"})
+        with pytest.raises(OSError):
+            http_client.request("GET", "http://256.0.0.1:9/x",
+                                timeout=1)
+
+    def test_metrics_port_control_plane(self):
+        import json
+        import urllib.request
+
+        from seaweedfs_tpu.stats.metrics import start_metrics_server
+        srv = start_metrics_server(0, ip="127.0.0.1", role="test")
+        port = srv.server_address[1]
+        try:
+            # without the process opt-in, POST is refused — a metrics
+            # port must never be a fault-injection surface by default
+            assert not failpoint.http_control_enabled()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/debug/failpoint",
+                data=json.dumps({"site": "x", "action": "error"}).encode(),
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 403
+            assert not failpoint._armed
+
+            failpoint.enable_http_control(True)
+            body = json.dumps({
+                "site": "rt.site", "action": "error",
+                "match": {"peer": ":1"}, "count": 5}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/debug/failpoint",
+                    data=body, method="POST"), timeout=5) as r:
+                table = json.load(r)
+            assert any(s["site"] == "rt.site" and s["count"] == 5
+                       for s in table)
+            with pytest.raises(FailpointError):
+                failpoint.hit("rt.site", peer="h:1")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/failpoint",
+                    timeout=5) as r:
+                assert any(s["site"] == "rt.site" for s in json.load(r))
+            body = json.dumps({"site": "rt.site",
+                               "action": "off"}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{port}/debug/failpoint",
+                    data=body, method="POST"), timeout=5) as r:
+                assert json.load(r) == []
+            assert not failpoint._armed
+            # junk is a 400, not a crash
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/debug/failpoint",
+                data=b'{"action": "explode"}', method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            failpoint.enable_http_control(False)
+            srv.shutdown()
+            srv.server_close()
+
+
+# -- deadline -----------------------------------------------------------------
+
+
+class TestDeadline:
+    def test_unset_by_default(self):
+        assert deadline.get() is None
+        assert deadline.remaining() is None
+        deadline.check("noop")   # no budget, no raise
+
+    def test_budget_scopes_and_never_extends(self):
+        with deadline.budget(0.5):
+            rem = deadline.remaining()
+            assert 0 < rem <= 0.5
+            with deadline.budget(10.0):   # inner cannot extend
+                assert deadline.remaining() <= 0.5
+            with deadline.budget(0.01):   # inner may shrink
+                assert deadline.remaining() <= 0.01
+            assert deadline.remaining() <= 0.5
+        assert deadline.remaining() is None
+
+    def test_check_raises_when_spent(self):
+        with deadline.budget(0.0):
+            with pytest.raises(DeadlineExceeded):
+                deadline.check("spent")
+            assert deadline.expired()
+
+    def test_header_roundtrip(self):
+        assert deadline.header_value() is None
+        with deadline.budget(1.5):
+            v = deadline.header_value()
+            rem = deadline.parse_header(v)
+            assert 1.3 < rem <= 1.5
+        assert deadline.parse_header("junk") is None
+        assert deadline.parse_header("-3") == 0.0
+
+    def test_http_client_refuses_spent_budget(self):
+        with deadline.budget(0.0):
+            with pytest.raises(DeadlineExceeded):
+                http_client.request("GET", "http://127.0.0.1:9/x")
+
+    def test_fanout_pool_carries_budget_across_threads(self):
+        pool = FanOutPool(2, "deadline-test")
+        try:
+            with deadline.budget(5.0):
+                fut = pool.submit(deadline.remaining)
+            got, exc = fut.wait(timeout=5)
+            assert exc is None
+            assert got is not None and 0 < got <= 5.0
+            # outside the scope, NEW submissions carry no budget
+            fut = pool.submit(deadline.remaining)
+            got, exc = fut.wait(timeout=5)
+            assert exc is None and got is None
+        finally:
+            pool.stop()
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+class TestBreaker:
+    def test_disabled_is_noop(self):
+        assert not breaker.enabled
+        breaker.check("p:1")
+        breaker.record("p:1", False)
+        assert breaker.sort_candidates(["a", "b"]) == ["a", "b"]
+
+    def test_state_machine(self):
+        breaker.configure(enable=True, threshold=3, cooldown_s=0.05)
+        b = breaker.for_peer("sm:1")
+        assert b.state == breaker.CLOSED
+        b.record(False)
+        b.record(False)
+        assert b.state == breaker.CLOSED    # under threshold
+        b.record(True)
+        b.record(False)
+        b.record(False)
+        assert b.state == breaker.CLOSED    # success reset the streak
+        b.record(False)
+        assert b.state == breaker.OPEN
+        with pytest.raises(BreakerOpen):
+            breaker.check("sm:1")
+        time.sleep(0.06)
+        assert b.allow()        # cooldown elapsed: the half-open probe
+        assert b.state == breaker.HALF_OPEN
+        assert not b.allow()    # only ONE probe at a time
+        b.record(False)
+        assert b.state == breaker.OPEN      # failed probe re-opens
+        time.sleep(0.06)
+        assert b.allow()
+        b.record(True)
+        assert b.state == breaker.CLOSED    # recovered
+
+    def test_sort_candidates_demotes_open_peers(self):
+        breaker.configure(enable=True, threshold=1, cooldown_s=30.0)
+        breaker.for_peer("dead:1").record(False)
+        assert breaker.sort_candidates(["dead:1", "live:1"]) == \
+            ["live:1", "dead:1"]
+        # sorting must not CREATE breakers for unknown peers
+        assert "live:1" not in [s for s in ()]  # (registry probe below)
+        assert not breaker.is_open("live:1")
+
+    def test_budget_shrunk_timeout_is_not_breaker_evidence(self):
+        """A timeout caused by the DEADLINE shrinking the socket
+        timeout below the caller's own says the client is impatient,
+        not that the peer is dead — it must never open the breaker."""
+        breaker.configure(enable=True, threshold=1, cooldown_s=30.0)
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)   # accepts, never answers
+        peer = f"127.0.0.1:{srv.getsockname()[1]}"
+        try:
+            with deadline.budget(0.15):
+                with pytest.raises(http_client.RequestTimeout):
+                    http_client.request("GET", f"http://{peer}/x",
+                                        timeout=30.0)
+            assert not breaker.is_open(peer)
+            # the SAME timeout without a budget is real evidence
+            with pytest.raises(http_client.RequestTimeout):
+                http_client.request("GET", f"http://{peer}/x",
+                                    timeout=0.15)
+            assert breaker.is_open(peer)
+        finally:
+            srv.close()
+
+    def test_http_client_feeds_breaker(self):
+        breaker.configure(enable=True, threshold=2, cooldown_s=30.0)
+        # unroutable port: every connect fails fast with ECONNREFUSED
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        peer = f"127.0.0.1:{port}"
+        for _ in range(2):
+            with pytest.raises(OSError):
+                http_client.request("GET", f"http://{peer}/x", timeout=1)
+        assert breaker.for_peer(peer).state == breaker.OPEN
+        with pytest.raises(BreakerOpen):
+            http_client.request("GET", f"http://{peer}/x", timeout=1)
+
+    def test_abandoned_half_open_probe_is_reclaimed(self):
+        """A probe whose caller never records (crashed, bailed on a
+        spent deadline) must not wedge the breaker open forever — the
+        slot is reclaimed after another cooldown."""
+        breaker.configure(enable=True, threshold=1, cooldown_s=0.05)
+        b = breaker.for_peer("probe:1")
+        b.record(False)
+        time.sleep(0.06)
+        assert b.allow()          # the probe slot, never recorded
+        assert not b.allow()
+        time.sleep(0.06)
+        assert b.allow()          # reclaimed, not wedged
+        b.record(True)
+        assert b.state == breaker.CLOSED
+
+    def test_state_exported_to_metrics(self):
+        from seaweedfs_tpu.stats.metrics import BreakerStateGauge
+        breaker.configure(enable=True, threshold=1, cooldown_s=30.0)
+        breaker.for_peer("exp:1").record(False)
+        assert BreakerStateGauge.labels("exp:1").value == breaker.OPEN
+
+
+# -- hedged reads -------------------------------------------------------------
+
+
+class TestHedger:
+    def test_fast_primary_never_hedges(self):
+        h = Hedger(delay_floor_s=0.2)
+        for _ in range(5):
+            assert h.fetch([lambda: "a", lambda: "b"]) == "a"
+        assert h.hedges == 0
+
+    def test_slow_primary_hedges_and_loser_is_abandoned(self):
+        h = Hedger(delay_floor_s=0.01)
+        release = threading.Event()
+
+        def slow():
+            release.wait(timeout=5)
+            return "slow"
+
+        t0 = time.monotonic()
+        assert h.fetch([slow, lambda: "fast"]) == "fast"
+        assert time.monotonic() - t0 < 1.0   # did not wait for slow
+        assert h.hedges == 1 and h.wins == 1
+        release.set()
+
+    def test_budget_denies_excess_hedges(self):
+        h = Hedger(delay_floor_s=0.005, budget_pct=0.0)
+
+        def slowish():
+            time.sleep(0.03)
+            return "primary"
+
+        assert h.fetch([slowish, lambda: "never"]) == "primary"
+        assert h.hedges == 0 and h.denied == 1
+
+    def test_failover_on_error_is_not_budgeted(self):
+        h = Hedger(delay_floor_s=5.0, budget_pct=0.0)
+
+        def bad():
+            raise OSError("down")
+
+        assert h.fetch([bad, lambda: "b"]) == "b"
+        assert h.hedges == 0
+
+    def test_all_candidates_fail_raises_first_error(self):
+        h = Hedger(delay_floor_s=0.001)
+
+        def bad1():
+            raise OSError("first")
+
+        def bad2():
+            raise OSError("second")
+
+        with pytest.raises(OSError, match="first"):
+            h.fetch([bad1, bad2])
+
+    def test_p95_tracking_moves_delay(self):
+        h = Hedger(delay_floor_s=0.001)
+        for _ in range(32):
+            h.observe(0.05)
+        assert h.hedge_delay() >= 0.05
+
+    def test_spent_deadline_refuses(self):
+        h = Hedger()
+        with deadline.budget(0.0):
+            with pytest.raises(DeadlineExceeded):
+                h.fetch([lambda: "a", lambda: "b"])
+
+    def test_mid_flight_deadline_keeps_its_type(self):
+        """A budget expiring DURING the fetch surfaces as
+        DeadlineExceeded even when the candidates themselves died with
+        the RequestTimeout the budget shrank — the server edges' 504
+        contract rides on the type."""
+        h = Hedger(delay_floor_s=0.01)
+
+        def slow_then_timeout():
+            time.sleep(0.2)
+            raise http_client.RequestTimeout("budget-sized timeout")
+
+        with deadline.budget(0.15):
+            with pytest.raises(DeadlineExceeded):
+                h.fetch([slow_then_timeout, slow_then_timeout])
+
+    def test_saturated_lanes_keep_failover(self):
+        """With every lane pinned by an abandoned loser, fetch()
+        degrades to inline — which must still WALK the candidates on
+        failure (failover is mandatory work, only hedging degrades)."""
+        h = Hedger(delay_floor_s=0.01, max_inflight=2)
+        gate = threading.Event()
+        results = []
+        t = threading.Thread(target=lambda: results.append(
+            h.fetch([lambda: (gate.wait(5), "slow")[1],
+                     lambda: "hedge"])))
+        t.start()
+        time.sleep(0.05)   # the blocked primary now pins the only lane
+
+        def bad():
+            raise OSError("down")
+
+        assert h.fetch([bad, lambda: "fallback"]) == "fallback"
+        # and the inline walk covers ALL remaining candidates, not
+        # just the next one
+        assert h.fetch([bad, bad, lambda: "third"]) == "third"
+        with pytest.raises(OSError, match="down"):
+            h.fetch([bad, bad, bad])
+        gate.set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert h._inflight == 0
+
+
+# -- retry --------------------------------------------------------------------
+
+
+class TestRetry:
+    def test_full_jitter_bounds(self):
+        sleeps = []
+        rolls = iter([1.0, 0.5, 0.0, 0.25, 0.75])
+
+        def boom():
+            raise http_client.ConnectError("x")
+
+        with pytest.raises(http_client.ConnectError):
+            retry("jit", boom, times=6, wait_seconds=0.1, backoff=2.0,
+                  _sleep=sleeps.append, _rand=lambda: next(rolls))
+        # sleep_k = rand * wait * backoff**k: jitter spans [0, wait_k]
+        assert sleeps == pytest.approx([0.1, 0.1, 0.0, 0.2, 1.2])
+        for k, s in enumerate(sleeps):
+            assert 0 <= s <= 0.1 * 2.0 ** k
+
+    def test_deadline_truncates_sleeps_and_stops(self):
+        sleeps = []
+        t = {"now": 0.0}
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            t["now"] += s
+
+        def boom():
+            raise http_client.ConnectError("x")
+
+        import seaweedfs_tpu.util.retry as retry_mod
+        real = time.monotonic
+        time_mod = retry_mod.time
+        orig = time_mod.monotonic
+        time_mod.monotonic = lambda: t["now"]
+        try:
+            with pytest.raises(http_client.ConnectError):
+                retry("dl", boom, times=10, wait_seconds=1.0,
+                      backoff=2.0, deadline=2.5, jitter=False,
+                      _sleep=fake_sleep)
+        finally:
+            time_mod.monotonic = orig
+        # 1.0 + truncated 1.5 == the whole budget, then stop
+        assert sleeps == [1.0, 1.5]
+        assert real  # silence linters
+
+    def test_spent_budget_at_entry_never_runs_fn(self):
+        calls = []
+        with deadline.budget(0.0):
+            with pytest.raises(DeadlineExceeded):
+                retry("never", lambda: calls.append(1), times=3)
+        assert calls == []
+
+    def test_default_classification(self):
+        from seaweedfs_tpu.util.retry import default_retryable
+        assert default_retryable(http_client.ConnectError("x"))
+        assert default_retryable(RuntimeError("generic"))
+        assert not default_retryable(http_client.RequestTimeout("x"))
+        assert not default_retryable(
+            http_client.ResponseError("post-send"))
+        assert not default_retryable(BreakerOpen("p:1"))
+        assert not default_retryable(DeadlineExceeded("x"))
+        # a retryable=True stale connection means NO byte reached the
+        # peer (the class's own contract): connect-class, replayable
+        assert default_retryable(
+            http_client._StaleConnection("idle close", retryable=True))
+        assert not default_retryable(
+            http_client._StaleConnection("mid-response"))
+
+    def test_timeout_not_replayed(self):
+        calls = []
+
+        def timeout_err():
+            calls.append(1)
+            raise http_client.RequestTimeout("slow peer")
+
+        with pytest.raises(http_client.RequestTimeout):
+            retry("to", timeout_err, times=5, _sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_nonretryable_passthrough(self):
+        def bad():
+            raise NonRetryableError("stop")
+
+        with pytest.raises(NonRetryableError):
+            retry("nr", bad, times=5, _sleep=lambda s: None)
+
+    def test_outcome_metrics(self):
+        from seaweedfs_tpu.stats.metrics import RetryAttemptsCounter
+        name = "metrics-case"
+        before = RetryAttemptsCounter.labels(name, "ok").value
+        retry(name, lambda: 1, times=3)
+        assert RetryAttemptsCounter.labels(name, "ok").value == \
+            before + 1
+
+
+# -- graceful shutdown --------------------------------------------------------
+
+
+class TestShutdown:
+    def test_fanout_pool_stop_drains_and_exits_workers(self):
+        pool = FanOutPool(4, "stoptest")
+        futs = [pool.submit(lambda i=i: i * 2) for i in range(16)]
+        pool.stop()
+        assert [f.wait(timeout=1)[0] for f in futs] == \
+            [i * 2 for i in range(16)]
+        # workers are gone; late submits run inline on the caller
+        fut = pool.submit(lambda: threading.current_thread().name)
+        got, exc = fut.wait(timeout=1)
+        assert exc is None
+        assert got == threading.current_thread().name
+
+    def test_lease_cache_close_stops_banking(self):
+        from seaweedfs_tpu.operation import operations
+        from seaweedfs_tpu.operation.assign_lease import LeaseCache
+
+        assigns = []
+
+        def fake_assign(master, count=1, **kw):
+            assigns.append(count)
+            return operations.Assignment(f"7,{len(assigns):x}00000000",
+                                         "s:80", "s:80", count)
+
+        lc = LeaseCache(count=8, assign_fn=fake_assign)
+        lc.acquire("m:1")
+        assert lc.depth() == 7
+        lc.close()
+        assert lc.depth() == 0
+        # acquire still works — straight to the master, nothing banked
+        lc.acquire("m:1")
+        assert lc.depth() == 0
+
+    def test_hedged_chunk_fetch_keeps_deadline_type(self):
+        """The filer's hedged chunk-fetch branch must surface a spent
+        budget as DeadlineExceeded (the 504 contract), never rewrap it
+        as IOError (the 500 no-reachable-replica arm)."""
+        from seaweedfs_tpu.filer import stream
+
+        h = Hedger(delay_floor_s=0.01)
+        with deadline.budget(0.0):
+            with pytest.raises(DeadlineExceeded):
+                stream.fetch_chunk_bytes(
+                    lambda fid: ["a:1", "b:1"], "9,1abc", hedger=h)
+
+    def test_masterclient_follow_survives_non_grpc_errors(self):
+        """An armed rpc.call failpoint raises OSError (not
+        grpc.RpcError) at stream-open — the keep-connected machinery
+        must treat that as one failed rotation step, never die."""
+        import seaweedfs_tpu.wdclient.masterclient as mc_mod
+
+        mc = mc_mod.MasterClient(["127.0.0.1:1"])
+        orig = mc_mod.master_stub
+        mc_mod.master_stub = lambda target: (_ for _ in ()).throw(
+            OSError("injected"))
+        try:
+            assert mc._follow("127.0.0.1:1") is False   # no raise
+        finally:
+            mc_mod.master_stub = orig
+
+    def test_masterclient_typed_unreachable_error(self):
+        from seaweedfs_tpu.wdclient.masterclient import (MasterClient,
+                                                         MasterUnreachable)
+        mc = MasterClient(["127.0.0.1:1", "127.0.0.1:2"])
+        with pytest.raises(MasterUnreachable) as ei:
+            mc.wait_until_connected(timeout=0.05)
+        assert "127.0.0.1:1" in str(ei.value)
+        assert "127.0.0.1:2" in str(ei.value)
+        assert isinstance(ei.value, TimeoutError)   # old catch sites
