@@ -284,6 +284,82 @@ def fleet_trace_bench(out_path: str = "bench_trace.json") -> dict:
     }
 
 
+def cluster_trace_bench() -> dict:
+    """--trace-cluster mode: enabled-path overhead of cluster-wide
+    tracing on the data plane, plus one stitched example trace.
+
+    Methodology: the test_data_plane_floor shape (in-process master +
+    volume server, run_benchmark_programmatic write+read) best-of-3
+    alternated tracer-off vs tracer-on at -trace.sample=1.0 (worst
+    case: EVERY request mints ids, buffers spans, and runs the tail
+    decision; production tail-only mode does strictly less). The
+    enabled/disabled throughput ratio is the BENCH_TRACE.json headline
+    — the PR 6-era plane is the 'off' arm measured on the same box, so
+    the comparison survives VM-speed drift.
+    """
+    import io
+    import pathlib
+    import tempfile
+
+    from seaweedfs_tpu.command.benchmark import run_benchmark_programmatic
+    from seaweedfs_tpu.stats import cluster_trace
+    from tests.cluster_util import Cluster
+
+    n = int(os.environ.get("BENCH_TRACE_CLUSTER_N", "2000"))
+
+    def one_run(enabled: bool, tmp) -> dict:
+        if enabled:
+            cluster_trace.enable(sample_fraction=1.0,
+                                 slow_threshold_ms=200.0)
+        else:
+            cluster_trace.disable()
+        try:
+            c = Cluster(tmp, n_volume_servers=1)
+            try:
+                r = run_benchmark_programmatic(
+                    c.master.url, n=n, concurrency=8, size=1024,
+                    do_read=True, out=io.StringIO())
+            finally:
+                c.stop()
+            return {
+                "write_rps": r["write"].completed / r["write_seconds"],
+                "read_rps": r["read"].completed / r["read_seconds"],
+                "failed": r["write"].failed + r["read"].failed,
+            }
+        finally:
+            cluster_trace.disable()
+            cluster_trace.reset()
+
+    runs = {"off": [], "on": []}
+    with tempfile.TemporaryDirectory() as d:
+        i = 0
+        for rep in range(3):   # alternate order per the house method
+            order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+            for arm in order:
+                sub = pathlib.Path(d) / f"r{i}"
+                sub.mkdir()
+                i += 1
+                runs[arm].append(one_run(arm == "on", sub))
+    best = {arm: {"write_rps": max(x["write_rps"] for x in rs),
+                  "read_rps": max(x["read_rps"] for x in rs)}
+            for arm, rs in runs.items()}
+    failed = sum(x["failed"] for rs in runs.values() for x in rs)
+    line = {
+        "metric": "cluster_trace_enabled_overhead",
+        "unit": "ratio_enabled_over_disabled",
+        "n": n,
+        "sample": 1.0,
+        "failed": failed,
+        "disabled": {k: round(v, 1) for k, v in best["off"].items()},
+        "enabled": {k: round(v, 1) for k, v in best["on"].items()},
+        "write_ratio": round(best["on"]["write_rps"]
+                             / best["off"]["write_rps"], 4),
+        "read_ratio": round(best["on"]["read_rps"]
+                            / best["off"]["read_rps"], 4),
+    }
+    return line
+
+
 def scrub_verify_sweep(batches=(1, 8)) -> dict:
     """--scrub mode: integrity-verify throughput of the scrub path.
 
@@ -855,6 +931,15 @@ def main() -> None:
         # scrub mode is host-pipeline only: verify throughput of the
         # integrity scanner, not the kernel headline
         print(json.dumps(scrub_verify_sweep()), flush=True)
+        return
+    if "--trace-cluster" in sys.argv:
+        # cluster-trace mode: enabled-path overhead of cross-hop
+        # tracing on the data plane (host-pipeline only)
+        line = cluster_trace_bench()
+        with open(os.path.join(REPO_ROOT, "BENCH_TRACE.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
         return
     if "--trace" in sys.argv:
         # trace mode is host-pipeline only (no TPU needed): stage

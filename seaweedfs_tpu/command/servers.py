@@ -90,6 +90,7 @@ def _master_parser() -> argparse.ArgumentParser:
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
+    _add_trace_args(p)
     return p
 
 
@@ -129,6 +130,7 @@ def _build_master(opts):
 def run_master(args) -> int:
     _setup_tls("master")
     opts = _master_parser().parse_args(args)
+    _configure_trace(opts)
     grace.setup_profiling(opts.cpuprofile)
     _maybe_start_metrics(opts, role="master")
     m = _build_master(opts)
@@ -191,11 +193,46 @@ def _volume_parser() -> argparse.ArgumentParser:
                    help="needle map kind: memory (dict rebuild from .idx) "
                         "or kv (persistent LogKV, O(live) reopen; reference "
                         "command/volume.go:203-211 leveldb kinds)")
+    p.add_argument("-heat.track", dest="heat_track", action="store_true",
+                   help="per-volume (and sampled per-needle) read-path "
+                        "heat telemetry: SeaweedFS_volume_heat{vid} + "
+                        "the Heat block on /status")
+    p.add_argument("-heat.windowSeconds", dest="heat_window_s",
+                   type=float, default=60.0,
+                   help="sliding window the heat gauge counts reads "
+                        "over")
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_resilience_args(p)
+    _add_trace_args(p)
     return p
+
+
+def _add_trace_args(p: argparse.ArgumentParser) -> None:
+    """Shared -trace.* flags (every role; see stats/cluster_trace.py).
+    Off by default — the cluster tracer costs one flag check per seam
+    until enabled."""
+    p.add_argument("-trace.sample", dest="trace_sample", type=float,
+                   default=-1.0,
+                   help="enable cluster tracing; head-sample this "
+                        "fraction of requests unconditionally (0 = "
+                        "tail-only: keep slow/errored requests; "
+                        "negative = tracing disabled)")
+    p.add_argument("-trace.slowMs", dest="trace_slow_ms", type=float,
+                   default=200.0,
+                   help="floor for the tail-sampling keep threshold: a "
+                        "request slower than max(this, the tracked "
+                        "per-verb p95) pins its span detail")
+
+
+def _configure_trace(opts) -> None:
+    if getattr(opts, "trace_sample", -1.0) >= 0:
+        from seaweedfs_tpu.stats import cluster_trace
+        cluster_trace.enable(sample_fraction=opts.trace_sample,
+                             slow_threshold_ms=opts.trace_slow_ms)
+        log.info("cluster tracing on (sample=%.3f slowMs=%.0f)",
+                 cluster_trace.sample, cluster_trace.slow_ms)
 
 
 def _add_resilience_args(p: argparse.ArgumentParser) -> None:
@@ -275,7 +312,9 @@ def _build_volume(opts):
         degraded_batch_ms=opts.degraded_batch_ms,
         replicate_parallel=opts.replicate_parallel,
         hedge_reads=opts.resilience_hedge,
-        hedge_delay_ms=opts.resilience_hedge_delay_ms)
+        hedge_delay_ms=opts.resilience_hedge_delay_ms,
+        heat_track=opts.heat_track,
+        heat_window_s=opts.heat_window_s)
 
 
 @command("volume", "start a volume server (data plane)")
@@ -283,6 +322,7 @@ def run_volume(args) -> int:
     _setup_tls("volume")
     opts = _volume_parser().parse_args(args)
     _configure_resilience(opts)
+    _configure_trace(opts)
     grace.setup_profiling(opts.cpuprofile)
     _maybe_start_metrics(opts, role="volume")
     vs = _build_volume(opts)
@@ -323,6 +363,7 @@ def _filer_parser() -> argparse.ArgumentParser:
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_resilience_args(p)
+    _add_trace_args(p)
     return p
 
 
@@ -364,6 +405,7 @@ def run_filer(args) -> int:
     _setup_tls("filer")
     opts = _filer_parser().parse_args(args)
     _configure_resilience(opts)
+    _configure_trace(opts)
     _maybe_start_metrics(opts, role="filer")
     fs = _build_filer(opts)
     fs.start()

@@ -526,6 +526,11 @@ class FuseMount:
         libfuse exit code (0 = clean)."""
         args = [b"seaweedfs-mount", self.mountpoint.encode(), b"-f",
                 b"-s",  # single-threaded loop: Wfs handles its own locks
+                # no kernel attr/entry caching: metadata changes made
+                # through ANOTHER name (hard-link bumping the original's
+                # nlink, write-through-one-name) must be visible on the
+                # next stat, not after the default 1s attr timeout
+                b"-o", b"attr_timeout=0,entry_timeout=0",
                 b"-o", f"fsname={self.fsname}".encode()]
         if allow_other:
             args += [b"-o", b"allow_other"]

@@ -196,6 +196,21 @@ class DegradedReadFleet:
             raise EcShardNotFound(
                 f"vid {ecv.volume_id} shard {missing_shard}: decode "
                 "fleet stopped")
+        # request-scoped span on the CALLER thread: the fleet's own
+        # batch/decode spans are shared across requests, but this one
+        # rides the ambient cluster-trace context, so a stitched trace
+        # shows how long THIS request waited on fused reconstruction
+        sp = trace.span("reads.degraded", vid=ecv.volume_id,
+                        shard=missing_shard, length=length) \
+            if trace.active() else trace.NOOP
+        with sp:
+            return self._decode_blocking(ecv, missing_shard, offset,
+                                         length, remote_reader)
+
+    def _decode_blocking(self, ecv, missing_shard: int, offset: int,
+                         length: int,
+                         remote_reader: Optional[Callable]) -> bytes:
+        from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
         req = _Request(ecv, missing_shard, offset, length, remote_reader)
         self._q.put(req)
         if self._stopping:

@@ -110,13 +110,27 @@ class Hedger:
         abandoned. A FAILED attempt triggers the next candidate
         immediately (failover, unbudgeted). Raises the first error
         once every candidate has failed."""
-        from seaweedfs_tpu.stats.metrics import (HedgeDeniedCounter,
-                                                 HedgeIssuedCounter,
-                                                 HedgeRequestsCounter,
-                                                 HedgeWinsCounter)
+        from seaweedfs_tpu.stats import trace
+        from seaweedfs_tpu.stats.metrics import HedgeRequestsCounter
         with self._lock:
             self.requests += 1
         HedgeRequestsCounter.inc()
+        # request-scoped span on the caller thread; candidate thunks
+        # run on the pool under copied contexts, so their own spans
+        # land in the same trace and parent to the request span
+        hsp = trace.span("hedge.fetch", candidates=len(fns)) \
+            if trace.active() else trace.NOOP
+        hsp.__enter__()
+        try:
+            return self._fetch(fns, timeout)
+        finally:
+            hsp.__exit__(None, None, None)
+
+    def _fetch(self, fns: Sequence[Callable[[], object]],
+               timeout: float):
+        from seaweedfs_tpu.stats.metrics import (HedgeDeniedCounter,
+                                                 HedgeIssuedCounter,
+                                                 HedgeWinsCounter)
         rem = deadline_mod.remaining()
         if rem is not None:
             if rem <= 0:

@@ -102,11 +102,15 @@ def _service_specs(pb2_module, service_name: str):
 
 
 def _resilient_call(multicallable, path: str):
-    """Wrap one multicallable with the outbound resilience edge: the
-    rpc.call failpoint and the ambient deadline (capping any caller
-    timeout to the remaining budget; gRPC itself propagates the
-    deadline to the server as context.time_remaining()). Both branches
-    are one flag/contextvar check when disarmed/unbudgeted."""
+    """Wrap one multicallable with the outbound resilience +
+    observability edge: the rpc.call failpoint, the ambient deadline
+    (capping any caller timeout to the remaining budget; gRPC itself
+    propagates the deadline to the server as context.time_remaining())
+    and — when cluster tracing is on — the x-seaweed-trace metadata
+    carrying the ambient trace context to the peer. Every branch is
+    one flag/contextvar check when disarmed/unbudgeted/untraced."""
+    from seaweedfs_tpu.stats import cluster_trace as _ctrace
+
     def invoke(request_or_iterator, timeout=None, **kwargs):
         if _failpoint._armed:
             _failpoint.hit("rpc.call", method=path)
@@ -118,6 +122,12 @@ def _resilient_call(multicallable, path: str):
                 DeadlineRefusedCounter.labels("rpc").inc()
                 raise _deadline.DeadlineExceeded(f"rpc {path}")
             timeout = rem if timeout is None else min(timeout, rem)
+        if _ctrace._enabled:
+            hdr = _ctrace.outbound_header()
+            if hdr is not None:
+                md = list(kwargs.get("metadata") or ())
+                md.append((_ctrace.GRPC_KEY, hdr))
+                kwargs["metadata"] = md
         return multicallable(request_or_iterator, timeout=timeout,
                              **kwargs)
     invoke.__name__ = path.rsplit("/", 1)[-1]
@@ -170,6 +180,11 @@ def generic_handler(pb2_module, service_name: str, servicer,
     from seaweedfs_tpu.stats.metrics import instrument_grpc_method
     if stats_role is None:
         stats_role = service_name[:1].lower() + service_name[1:]
+    # the cluster tracer labels request spans with the serving node's
+    # address so the stitcher groups gRPC and HTTP ingress of one
+    # server into the same process lane (servicers expose .url;
+    # address-less ones like RaftNode just label empty)
+    server_url = getattr(servicer, "url", "") or ""
     svc, specs = _service_specs(pb2_module, service_name)
     handlers = {}
     for spec in specs:
@@ -181,7 +196,8 @@ def generic_handler(pb2_module, service_name: str, servicer,
         else:
             fn = instrument_grpc_method(
                 fn, stats_role, spec.name,
-                server_streaming=spec.server_streaming)
+                server_streaming=spec.server_streaming,
+                server=server_url)
         if spec.client_streaming and spec.server_streaming:
             make = grpc.stream_stream_rpc_method_handler
         elif spec.client_streaming:

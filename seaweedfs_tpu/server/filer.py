@@ -791,6 +791,14 @@ def _make_http_handler(fs: FilerServer):
 
         def do_GET(self):
             path, params = self._path_and_params()
+            if path in ("/debug/trace", "/debug/requests"):
+                # reserved collector/flight-recorder paths (never
+                # namespace lookups): cluster.trace fans out over the
+                # filer's data port like every other role
+                from seaweedfs_tpu.stats import cluster_trace
+                self._json(cluster_trace.debug_payload(
+                    self.path, "filer", fs.url))
+                return
             try:
                 entry = fs.filer.find_entry(path)
             except NotFound:

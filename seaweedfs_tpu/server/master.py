@@ -867,6 +867,13 @@ def _make_http_handler(ms: MasterServer):
         def do_GET(self):
             upath, sep, query = self.path.partition("?")
             params = parse_qs(query) if sep else {}
+            if upath in ("/debug/trace", "/debug/requests"):
+                # local collector/flight-recorder state — never proxied
+                # to the leader (each process answers for itself)
+                from seaweedfs_tpu.stats import cluster_trace
+                self._json(cluster_trace.debug_payload(
+                    self.path, "master", ms.url))
+                return
             if upath != "/cluster/status" and self._proxy_to_leader():
                 return
             if upath == "/dir/assign":

@@ -85,7 +85,9 @@ class VolumeServer:
                  degraded_batch_ms: float = 2.0,
                  replicate_parallel: int = 8,
                  hedge_reads: bool = False,
-                 hedge_delay_ms: float = 10.0):
+                 hedge_delay_ms: float = 10.0,
+                 heat_track: bool = False,
+                 heat_window_s: float = 60.0):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -157,6 +159,11 @@ class VolumeServer:
             self.hedger = Hedger(
                 delay_floor_s=max(hedge_delay_ms, 0.1) / 1000.0,
                 name=f"hedge-volume-{port}")
+        # read-path heat telemetry (-heat.track): absent — not merely
+        # idle — unless enabled, so the disabled read path pays one
+        # None check (the lifecycle subsystem's measurement half)
+        from seaweedfs_tpu.stats.heat import make_tracker
+        self.heat = make_tracker(heat_track, window_s=heat_window_s)
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -195,6 +202,8 @@ class VolumeServer:
     def stop(self) -> None:
         log.info("volume server %s:%d stopping", self.ip, self.port)
         self._stopping = True
+        if self.heat is not None:
+            self.heat.close()
         if self.degraded is not None:
             self.degraded.stop()
         self.scrub.stop()
@@ -912,6 +921,11 @@ class VolumeServer:
     # -- needle data ops (shared by HTTP and gRPC paths) -----------------------
 
     def _read_needle(self, vid: int, n: Needle) -> Needle:
+        if self.heat is not None:
+            # counted at admission, not success: a read of a dead
+            # needle still heats the volume (the lifecycle policy cares
+            # about demand, not hit rate)
+            self.heat.record(vid, n.id)
         if self.store.has_volume(vid):
             got = self.store.read_needle(vid, n)
         elif self.store.find_ec_volume(vid) is not None:
@@ -1258,6 +1272,14 @@ def _make_http_handler(vs: VolumeServer):
             if upath == "/status":
                 self._json(self.server_status())
                 return
+            if upath in ("/debug/trace", "/debug/requests"):
+                # cluster-trace collector + flight recorder on the data
+                # port too: cluster.trace fans out over topology node
+                # urls, which are HTTP ports, not metrics ports
+                from seaweedfs_tpu.stats import cluster_trace
+                self._json(cluster_trace.debug_payload(
+                    self.path, "volumeServer", vs.url))
+                return
             if upath in ("/ui", "/ui/"):
                 import html as _html
                 st = self.server_status()
@@ -1340,6 +1362,8 @@ def _make_http_handler(vs: VolumeServer):
                 "Scrub": vs.scrub.status(),
                 "Cache": vs.read_cache.stats()
                 if vs.read_cache is not None else {"enabled": False},
+                "Heat": vs.heat.snapshot()
+                if vs.heat is not None else {"enabled": False},
             }
 
         def _redirect_to_replica(self, f) -> None:

@@ -5,6 +5,7 @@ command_fs_lock_unlock.go, command_cluster_check-ish status)."""
 from __future__ import annotations
 
 import argparse
+import json
 from typing import List
 
 from seaweedfs_tpu.pb import master_pb2
@@ -44,6 +45,147 @@ def cluster_status(env: CommandEnv, argv: List[str], out) -> None:
               f"volumes: {topo.volume_count}/{topo.max_volume_count}\n"
               f"used bytes: {stats.used_size}\n"
               f"files: {stats.file_count}\n")
+
+
+def stitch_chrome_trace(span_lists) -> dict:
+    """Merge per-server span lists (the /debug/trace?trace_id= answers)
+    into one Chrome trace-event JSON: each server becomes a named
+    process lane, spans dedupe by id (an in-process test cluster's
+    servers share one collector, so every endpoint answers with the
+    same spans), and timestamps are already epoch-based microseconds so
+    lanes line up across processes. Pure over the fetched lists — unit-
+    testable without a cluster (the house planning-function pattern)."""
+    events = []
+    pids = {}
+    seen = set()
+    for spans in span_lists:
+        for s in spans:
+            sid = s.get("id")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            proc = f"{s.get('role', '?')} {s.get('server', '?')}"
+            pid = pids.get(proc)
+            if pid is None:
+                pid = pids[proc] = len(pids) + 1
+                events.append({"ph": "M", "pid": pid, "tid": 0,
+                               "name": "process_name",
+                               "args": {"name": proc}})
+            args = dict(s.get("tags") or {})
+            args["id"] = sid
+            if s.get("parent"):
+                args["parent"] = s["parent"]
+            if s.get("trace"):
+                args["trace"] = s["trace"]
+            if s.get("in_flight"):
+                args["in_flight"] = True
+            events.append({"ph": "X", "pid": pid,
+                           "tid": s.get("tid", 0),
+                           "name": s.get("name", "?"),
+                           "ts": s.get("ts_us", 0),
+                           "dur": s.get("dur_us", 0),
+                           "args": args})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+@command("cluster.trace", "fetch + stitch one trace id across every server")
+def cluster_trace_cmd(env: CommandEnv, argv: List[str], out) -> None:
+    """Fan GET /debug/trace?trace_id= over the master, every volume
+    server, and (when the shell knows one) the filer, then stitch one
+    Chrome-trace JSON for the request — the cross-process view the
+    per-process span rings cannot give."""
+    from seaweedfs_tpu.util import http_client
+    p = argparse.ArgumentParser(prog="cluster.trace")
+    p.add_argument("-traceId", required=True,
+                   help="the 16-hex-digit trace id (from the slow-"
+                        "request log, /debug/requests, or a /metrics "
+                        "exemplar)")
+    p.add_argument("-out", default="",
+                   help="write the stitched Chrome trace JSON here "
+                        "(default: print a summary only)")
+    args = p.parse_args(argv)
+    targets = [env.master_url]
+    targets += sorted(dn.id for _, _, dn in
+                      env.data_nodes(env.topology()))
+    if env.filer_url:
+        targets.append(env.filer_url)
+    span_lists, reached = [], 0
+    for url in targets:
+        try:
+            resp = http_client.request(
+                "GET", f"{url}/debug/trace?trace_id={args.traceId}",
+                timeout=10)
+        except OSError as e:
+            out.write(f"{url}: unreachable ({e})\n")
+            continue
+        if resp.status != 200:
+            out.write(f"{url}: HTTP {resp.status}\n")
+            continue
+        reached += 1
+        try:
+            spans = json.loads(resp.body).get("spans", [])
+        except ValueError:
+            spans = []
+        if spans:
+            out.write(f"{url}: {len(spans)} spans\n")
+        span_lists.append(spans)
+    stitched = stitch_chrome_trace(span_lists)
+    n_spans = sum(1 for e in stitched["traceEvents"] if e["ph"] == "X")
+    n_procs = sum(1 for e in stitched["traceEvents"] if e["ph"] == "M")
+    out.write(f"trace {args.traceId}: {n_spans} spans across "
+              f"{n_procs} processes ({reached}/{len(targets)} servers "
+              f"answered)\n")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(stitched, f)
+        out.write(f"chrome trace written to {args.out}\n")
+    elif n_spans == 0:
+        out.write("no spans found: the trace may have been dropped by "
+                  "tail sampling (only slow/errored/head-sampled "
+                  "requests are pinned) or aged out of the rings\n")
+
+
+@command("cluster.requests", "live in-flight request table, cluster-wide")
+def cluster_requests(env: CommandEnv, argv: List[str], out) -> None:
+    """Fan GET /debug/requests over every server: the flight recorder
+    view an operator opens when something is stuck RIGHT NOW."""
+    from seaweedfs_tpu.util import http_client
+    targets = [env.master_url]
+    targets += sorted(dn.id for _, _, dn in
+                      env.data_nodes(env.topology()))
+    if env.filer_url:
+        targets.append(env.filer_url)
+    rows = []
+    for url in targets:
+        try:
+            resp = http_client.request("GET", f"{url}/debug/requests",
+                                       timeout=10)
+        except OSError:
+            continue
+        if resp.status != 200:
+            continue
+        try:
+            rows.extend(json.loads(resp.body).get("requests", []))
+        except ValueError:
+            continue
+    # an in-process cluster's servers share one table and answer the
+    # same rows from every endpoint: dedupe on the request-span id
+    # (stable per request; age_ms is recomputed per fetch)
+    seen = set()
+    rows = [r for r in rows
+            if r.get("id") not in seen and not seen.add(r.get("id"))]
+    rows.sort(key=lambda r: -r.get("age_ms", 0))
+    if not rows:
+        out.write("no traced requests in flight\n")
+        return
+    for r in rows:
+        budget = r.get("deadline_left_ms")
+        out.write(
+            f"{r.get('trace_id')} {r.get('role')}.{r.get('verb')} "
+            f"{r.get('path')} age={r.get('age_ms', 0):.0f}ms "
+            f"span={r.get('current_span')} peer={r.get('peer')}"
+            + (f" budget={budget:.0f}ms" if budget is not None else "")
+            + "\n")
 
 
 @command("lock", "acquire the cluster admin lock")

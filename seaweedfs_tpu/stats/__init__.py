@@ -1,4 +1,5 @@
-"""Prometheus-style metrics + span tracing (reference: weed/stats)."""
+"""Prometheus-style metrics + span tracing + cluster-wide trace
+propagation (reference: weed/stats)."""
 
 from seaweedfs_tpu.stats import trace  # noqa: F401
 from seaweedfs_tpu.stats.metrics import (  # noqa: F401
@@ -6,3 +7,4 @@ from seaweedfs_tpu.stats.metrics import (  # noqa: F401
     instrument_grpc_method, instrument_http_handler,
     start_metrics_server,
 )
+from seaweedfs_tpu.stats import cluster_trace  # noqa: E402,F401

@@ -9,6 +9,7 @@ prometheus_client package is not in the image).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import urllib.request
@@ -64,7 +65,7 @@ class _Metric:
     def _default(self):
         return self.labels() if not self.label_names else None
 
-    def collect(self) -> str:
+    def collect(self, openmetrics: bool = False) -> str:
         raise NotImplementedError
 
 
@@ -89,7 +90,7 @@ class Counter(_Metric):
     def inc(self, amount: float = 1.0) -> None:
         self.labels().inc(amount)
 
-    def collect(self) -> str:
+    def collect(self, openmetrics: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
@@ -136,7 +137,8 @@ class Gauge(Counter):
 
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+    __slots__ = ("buckets", "counts", "total", "count", "_lock",
+                 "exemplars")
 
     def __init__(self, buckets):
         self.buckets = buckets
@@ -144,6 +146,11 @@ class _HistogramChild:
         self.total = 0.0
         self.count = 0
         self._lock = threading.Lock()
+        # bucket index -> (trace_id_hex, value, unix_ts): the last
+        # sampled observation that landed in that bucket. None until
+        # cluster tracing records one — the exemplar-free exposition is
+        # byte-identical to the pre-exemplar format.
+        self.exemplars: Optional[Dict[int, tuple]] = None
 
     def observe(self, v: float) -> None:
         with self._lock:
@@ -152,6 +159,24 @@ class _HistogramChild:
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self.counts[i] += 1
+
+    def observe_exemplar(self, v: float, trace_id: str) -> None:
+        """observe() plus an OpenMetrics exemplar linking the bucket
+        this value landed in to the trace id — the /metrics ->
+        cluster.trace pivot."""
+        with self._lock:
+            self.total += v
+            self.count += 1
+            hit = None
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    if hit is None:
+                        hit = i
+            if self.exemplars is None:
+                self.exemplars = {}
+            self.exemplars[len(self.buckets) if hit is None else hit] = \
+                (trace_id, v, time.time())
 
     def time(self):
         return _Timer(self)
@@ -184,23 +209,37 @@ class Histogram(_Metric):
     def observe(self, v: float) -> None:
         self.labels().observe(v)
 
-    def collect(self) -> str:
+    def collect(self, openmetrics: bool = False) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             items = list(self._children.items())
         for values, child in items:
-            for b, c in zip(child.buckets, child.counts):
+            # exemplars are ONLY legal in the OpenMetrics exposition —
+            # a classic text-format (0.0.4) parser hits the '#' after
+            # the value and fails the whole scrape, so the default
+            # render stays byte-identical to the pre-exemplar format
+            ex = child.exemplars if openmetrics else None
+            for i, (b, c) in enumerate(zip(child.buckets, child.counts)):
                 le = 'le="%s"' % b
-                lines.append(
-                    f"{self.name}_bucket"
-                    f"{_fmt_labels(self.label_names, values, le)}"
-                    f" {c}")
+                line = (f"{self.name}_bucket"
+                        f"{_fmt_labels(self.label_names, values, le)}"
+                        f" {c}")
+                if ex and i in ex:
+                    # OpenMetrics exemplar: "# {trace_id=...} v ts" —
+                    # emitted only once cluster tracing has linked one
+                    tid, v, ts = ex[i]
+                    line += (f' # {{trace_id="{tid}"}} {v:.6f} '
+                             f"{ts:.3f}")
+                lines.append(line)
             le_inf = 'le="+Inf"'
-            lines.append(
-                f"{self.name}_bucket"
-                f"{_fmt_labels(self.label_names, values, le_inf)}"
-                f" {child.count}")
+            line = (f"{self.name}_bucket"
+                    f"{_fmt_labels(self.label_names, values, le_inf)}"
+                    f" {child.count}")
+            if ex and len(child.buckets) in ex:
+                tid, v, ts = ex[len(child.buckets)]
+                line += f' # {{trace_id="{tid}"}} {v:.6f} {ts:.3f}'
+            lines.append(line)
             lines.append(f"{self.name}_sum"
                          f"{_fmt_labels(self.label_names, values)}"
                          f" {child.total}")
@@ -229,10 +268,13 @@ class Registry:
                   buckets=_DEFAULT_BUCKETS) -> Histogram:
         return self.register(Histogram(name, help_text, label_names, buckets))
 
-    def render(self) -> str:
+    def render(self, openmetrics: bool = False) -> str:
+        """Text exposition. `openmetrics=True` adds exemplar suffixes
+        (and is only served under the application/openmetrics-text
+        content type — classic 0.0.4 parsers reject exemplars)."""
         with self._lock:
             metrics = list(self._metrics.values())
-        return "\n".join(m.collect() for m in metrics) + "\n"
+        return "\n".join(m.collect(openmetrics) for m in metrics) + "\n"
 
 
 REGISTRY = Registry()
@@ -420,6 +462,68 @@ DeadlineRefusedCounter = REGISTRY.counter(
     "work refused because the request's budget was already spent",
     ("where",))
 
+# Cluster-trace families (stats/cluster_trace.py): the tail sampler's
+# ledger — how many traced requests finished in each keep/drop class —
+# plus the flight recorder's live-table depth.
+TraceRequestsCounter = REGISTRY.counter(
+    "SeaweedFS_trace_requests_total",
+    "traced requests by sampling outcome "
+    "(slow | error | sample | drop)", ("outcome",))
+TraceLiveGauge = REGISTRY.gauge(
+    "SeaweedFS_trace_live_requests",
+    "in-flight traced requests (the /debug/requests table depth)")
+
+# Heat telemetry (stats/heat.py): read-path access rate per volume —
+# the measurement half of the heat-driven lifecycle (ROADMAP item 3).
+VolumeHeatGauge = REGISTRY.gauge(
+    "SeaweedFS_volume_heat",
+    "reads of this volume within the sliding heat window", ("vid",))
+
+# Process self-telemetry: evaluated at scrape time only (callable
+# gauges), so every bench gets RSS/fd/thread/GC correlation for free.
+ProcessRSSGauge = REGISTRY.gauge(
+    "SeaweedFS_process_resident_memory_bytes",
+    "resident set size of this process")
+ProcessFdsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_open_fds", "open file descriptors")
+ProcessThreadsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_threads", "live python threads")
+ProcessGcCollectionsGauge = REGISTRY.gauge(
+    "SeaweedFS_process_gc_collections",
+    "cumulative garbage collections across all generations")
+
+
+def _rss_bytes() -> float:
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                               if hasattr(os, "sysconf")
+                                               else 4096)
+    except (OSError, ValueError, IndexError):
+        return 0.0
+
+
+def _open_fds() -> float:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return 0.0
+
+
+def _gc_collections() -> float:
+    import gc
+    return float(sum(s.get("collections", 0) for s in gc.get_stats()))
+
+
+def _register_process_metrics() -> None:
+    ProcessRSSGauge.set_function(_rss_bytes)
+    ProcessFdsGauge.set_function(_open_fds)
+    ProcessThreadsGauge.set_function(lambda: float(threading.active_count()))
+    ProcessGcCollectionsGauge.set_function(_gc_collections)
+
+
+_register_process_metrics()
+
 
 # -- shared request instrumentation -------------------------------------------
 #
@@ -436,13 +540,27 @@ def instrument_http_handler(handler_cls, role: str):
     keep-alive idle time between requests is never measured as request
     latency. Returns the class for chaining.
 
-    Also the single deadline-ingress point for HTTP: a request carrying
-    X-Seaweed-Deadline has its remaining budget re-anchored into the
-    handler thread's contextvar, so every outbound hop the handler
-    makes (pooled HTTP, gRPC, retries) inherits the shrinking budget.
-    Requests without the header pay one dict lookup."""
+    Also the single deadline AND trace-context ingress point for HTTP:
+    a request carrying X-Seaweed-Deadline has its remaining budget
+    re-anchored into the handler thread's contextvar, and (when
+    cluster tracing is on) X-Seaweed-Trace re-anchors the trace
+    context the same way, so every outbound hop the handler makes
+    (pooled HTTP, gRPC, retries, fan-out pools) inherits both.
+    Requests without the headers pay one dict lookup + one flag check."""
     from seaweedfs_tpu.resilience import deadline as deadline_mod
-    from seaweedfs_tpu.stats import trace
+    from seaweedfs_tpu.stats import cluster_trace, trace
+
+    if not getattr(handler_cls, "_status_hooked", False):
+        # record the last status code sent, so the tail sampler can
+        # keep 5xx requests that answered instead of raising (both
+        # reply styles: fast_reply sets last_status itself)
+        handler_cls._status_hooked = True
+        orig_send = handler_cls.send_response
+
+        def send_response(self, code, *a):
+            self.last_status = code
+            return orig_send(self, code, *a)
+        handler_cls.send_response = send_response
 
     def _wrap(methname):
         orig = getattr(handler_cls, methname)
@@ -459,17 +577,38 @@ def instrument_http_handler(handler_cls, role: str):
                 rem = deadline_mod.parse_header(hdr)
                 if rem is not None:
                     token = deadline_mod.set_budget(rem)
+            ct = None
+            if cluster_trace._enabled:
+                self.last_status = 0
+                ct = cluster_trace.begin(
+                    role, verb, self.path,
+                    self.headers.get(cluster_trace.HEADER_LOWER),
+                    peer=self.client_address[0],
+                    server="%s:%d" % self.server.server_address[:2])
             sp = trace.span(span_name, path=self.path) \
                 if trace.is_enabled() else trace.NOOP
             sp.__enter__()
+            exc = None
             try:
                 orig(self)
+            except BaseException as e:
+                exc = e
+                raise
             finally:
                 sp.__exit__(None, None, None)
                 if token is not None:
                     deadline_mod.reset(token)
                 counter.inc()
-                histogram.observe(time.perf_counter() - t0)
+                dur = time.perf_counter() - t0
+                if ct is not None:
+                    kept = cluster_trace.finish(
+                        ct, exc, getattr(self, "last_status", 0))
+                    if kept is not None:
+                        histogram.observe_exemplar(dur, kept)
+                    else:
+                        histogram.observe(dur)
+                else:
+                    histogram.observe(dur)
         wrapped.__name__ = methname
         return wrapped
 
@@ -479,7 +618,8 @@ def instrument_http_handler(handler_cls, role: str):
 
 
 def instrument_grpc_method(fn, role: str, method_name: str,
-                           server_streaming: bool = False):
+                           server_streaming: bool = False,
+                           server: str = ""):
     """Wrap one gRPC servicer method with the request counter + latency
     histogram (+ trace span). Used by rpc.generic_handler for every
     service a server registers — the single gRPC instrumentation point.
@@ -490,12 +630,13 @@ def instrument_grpc_method(fn, role: str, method_name: str,
     would report nothing while the cluster runs and then poison
     _sum/_count with one hours-long sample at shutdown.
 
-    Unary methods are also the deadline-ingress point for gRPC: the
-    caller's deadline (context.time_remaining()) re-anchors into the
-    handler thread's contextvar so downstream hops inherit the budget
+    Unary methods are also the deadline AND trace-context ingress
+    point for gRPC: the caller's deadline (context.time_remaining())
+    re-anchors into the handler thread's contextvar, and the
+    x-seaweed-trace metadata key re-anchors the cluster-trace context
     (streams are exempt — they live for the process lifetime)."""
     from seaweedfs_tpu.resilience import deadline as deadline_mod
-    from seaweedfs_tpu.stats import trace
+    from seaweedfs_tpu.stats import cluster_trace, trace
     counter = RequestCounter.labels(role, method_name)
     histogram = RequestHistogram.labels(role, method_name)
     span_name = f"grpc.{role}.{method_name}"
@@ -516,16 +657,39 @@ def instrument_grpc_method(fn, role: str, method_name: str,
             # math into an instant DEADLINE_EXCEEDED
             if rem is not None and rem < 86400.0 * 365:
                 token = deadline_mod.set_budget(rem)
+            ct = None
+            if cluster_trace._enabled:
+                hdr = None
+                for k, v in (context.invocation_metadata() or ()):
+                    if k == cluster_trace.GRPC_KEY:
+                        hdr = v
+                        break
+                ct = cluster_trace.begin(role, method_name,
+                                         f"grpc/{method_name}", hdr,
+                                         peer=context.peer() or "",
+                                         server=server)
             sp = trace.span(span_name) if trace.is_enabled() else trace.NOOP
             sp.__enter__()
+            exc = None
             try:
                 return fn(request, context)
+            except BaseException as e:
+                exc = e
+                raise
             finally:
                 sp.__exit__(None, None, None)
                 if token is not None:
                     deadline_mod.reset(token)
                 counter.inc()
-                histogram.observe(time.perf_counter() - t0)
+                dur = time.perf_counter() - t0
+                if ct is not None:
+                    kept = cluster_trace.finish(ct, exc)
+                    if kept is not None:
+                        histogram.observe_exemplar(dur, kept)
+                    else:
+                        histogram.observe(dur)
+                else:
+                    histogram.observe(dur)
     wrapped.__name__ = method_name
     return wrapped
 
@@ -534,23 +698,36 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
                          ip: str = "", role: str = "") -> ThreadingHTTPServer:
     """Serve GET /metrics (Prometheus text), GET /healthz (role +
     uptime JSON, the readiness probe tests/cluster_util.py polls),
-    GET /debug/trace (Chrome trace-event JSON of the span ring) and
-    GET|POST /debug/failpoint (the fault-injection control plane:
-    GET lists the armed table, POST arms/disarms — see
-    resilience/failpoint.py for the JSON body). Any other path is 404;
-    other methods get the stock 501."""
+    GET /debug/trace (Chrome trace-event JSON of the span ring;
+    ?trace_id=<hex> switches to the cluster collector answering one
+    trace's spans, ?sampled=1 lists kept traces), GET /debug/requests
+    (the flight recorder's live request table) and GET|POST
+    /debug/failpoint (the fault-injection control plane: GET lists the
+    armed table, POST arms/disarms — see resilience/failpoint.py for
+    the JSON body). Any other path is 404; other methods get the stock
+    501."""
     import json as _json
+    from urllib.parse import parse_qs as _parse_qs
 
     from seaweedfs_tpu.resilience import failpoint
-    from seaweedfs_tpu.stats import trace
+    from seaweedfs_tpu.stats import cluster_trace, trace
 
     started = time.time()
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            path = self.path.partition("?")[0]
+            path, _, query = self.path.partition("?")
+            params = _parse_qs(query) if query else {}
             if path == "/metrics":
-                body = registry.render().encode()
+                # exemplar suffixes only on the EXPLICIT ?exemplars=1
+                # opt-in, never by content negotiation: Prometheus
+                # sends an openmetrics Accept by default, and this
+                # exposition is not fully OpenMetrics-conformant (no
+                # `# EOF`, counter naming) — answering that Accept
+                # with exemplars would fail every default scrape.
+                # The default render stays plain 0.0.4 text.
+                om = bool(params.get("exemplars", [""])[0])
+                body = registry.render(openmetrics=om).encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/healthz":
                 body = _json.dumps({
@@ -559,7 +736,20 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
                 }).encode()
                 ctype = "application/json"
             elif path == "/debug/trace":
-                body = trace.chrome_trace_json().encode()
+                if params.get("trace_id", [""])[0] or \
+                        params.get("sampled", [""])[0]:
+                    # the shared collector payload (same shape as the
+                    # role data-port carve-outs — one implementation)
+                    body = _json.dumps(cluster_trace.debug_payload(
+                        self.path, role or "unknown", "")).encode()
+                else:
+                    # bare /debug/trace keeps the PR 2 contract: the
+                    # Chrome trace JSON of the local span ring
+                    body = trace.chrome_trace_json().encode()
+                ctype = "application/json"
+            elif path == "/debug/requests":
+                body = _json.dumps(cluster_trace.debug_payload(
+                    self.path, role or "unknown", "")).encode()
                 ctype = "application/json"
             elif path == "/debug/failpoint":
                 body = _json.dumps(failpoint.active()).encode()

@@ -27,9 +27,11 @@ Chrome JSON of everything currently in the ring.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import json
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -44,8 +46,39 @@ DEFAULT_CAPACITY = 1 << 17
 _enabled = bool(os.environ.get("SEAWEED_TRACE", "") not in ("", "0"))
 _ring: deque = deque(maxlen=DEFAULT_CAPACITY)
 _ids = itertools.count(1)      # .__next__ is atomic under the GIL
+# Span ids are 64-bit and unique ACROSS processes: a per-process random
+# high word (bit 62 forced so ids never collide with the small ids of a
+# process that lost its randomness) ORed with the local counter. The
+# cluster stitcher dedupes by span id, so two processes must never mint
+# the same one.
+_ID_BASE = (random.getrandbits(30) | (1 << 29)) << 33
 _tls = threading.local()
 _thread_names: Dict[int, str] = {}
+
+# perf_counter -> wall-clock offset, taken once at import: the cluster
+# collector exports span timestamps on the epoch timebase so spans from
+# different PROCESSES line up in one stitched view (NTP-grade skew is
+# acceptable at the millisecond scale these traces are read at).
+EPOCH_OFFSET = time.time() - time.perf_counter()
+
+# Cluster-trace hook (stats/cluster_trace.py): when on, spans are also
+# appended to the ambient request's bounded buffer, carried across
+# threads by contextvars (FanOutPool copies the context at submit).
+# Kept as one module flag + one ContextVar so the fully-disabled span()
+# fast path stays two attribute checks.
+_cluster_enabled = False
+_req_ctx: "contextvars.ContextVar[Optional[object]]" = \
+    contextvars.ContextVar("seaweed_trace_req", default=None)
+
+
+def next_span_id() -> int:
+    """A fresh 64-bit process-unique span/trace id."""
+    return _ID_BASE | next(_ids)
+
+
+def request_ctx():
+    """The ambient cluster-trace request context (or None)."""
+    return _req_ctx.get()
 
 
 def is_enabled() -> bool:
@@ -89,16 +122,18 @@ NOOP = _NoopSpan()
 
 
 class Span:
-    __slots__ = ("name", "tags", "id", "parent_id", "t0", "dur", "tid")
+    __slots__ = ("name", "tags", "id", "parent_id", "t0", "dur", "tid",
+                 "trace_id")
 
     def __init__(self, name: str, parent: Optional[int], tags: dict):
         self.name = name
         self.tags = tags
-        self.id = next(_ids)
+        self.id = _ID_BASE | next(_ids)
         self.parent_id = parent
         self.t0 = 0.0
         self.dur = 0.0
         self.tid = 0
+        self.trace_id = 0
 
     def __enter__(self) -> "Span":
         tid = threading.get_ident()
@@ -110,6 +145,15 @@ class Span:
             stack = _tls.stack = []
         if self.parent_id is None and stack:
             self.parent_id = stack[-1]
+        if _cluster_enabled:
+            ctx = _req_ctx.get()
+            if ctx is not None:
+                self.trace_id = ctx.trace_id
+                ctx.current = self.name   # flight-recorder "where is it"
+                if self.parent_id is None:
+                    # first span on a pool/hedge worker thread: parent
+                    # to the request span across the thread boundary
+                    self.parent_id = ctx.span_id
         stack.append(self.id)
         self.t0 = time.perf_counter()
         return self
@@ -119,7 +163,12 @@ class Span:
         stack = getattr(_tls, "stack", None)
         if stack and stack[-1] == self.id:
             stack.pop()
-        _ring.append(self)
+        if _enabled:
+            _ring.append(self)
+        if _cluster_enabled:
+            ctx = _req_ctx.get()
+            if ctx is not None:
+                ctx.add_span(self)
         return False
 
     def token(self) -> int:
@@ -135,10 +184,20 @@ def span(name: str, parent: Optional[int] = None, **tags):
     cross-thread nesting; same-thread nesting is automatic. Callers on
     paths hot enough that even the kwargs dict matters should gate on
     is_enabled() themselves.
+
+    Enabled means EITHER the local span ring (SEAWEED_TRACE) or the
+    cluster tracer (stats/cluster_trace.py) is on — with both off the
+    fast path is two module-flag checks returning the shared no-op.
     """
-    if not _enabled:
+    if not _enabled and not _cluster_enabled:
         return NOOP
     return Span(name, parent, tags)
+
+
+def active() -> bool:
+    """True when span() would record anything right now — the guard
+    hot callers use before building a tags dict."""
+    return _enabled or (_cluster_enabled and _req_ctx.get() is not None)
 
 
 def handoff() -> Optional[int]:
@@ -179,9 +238,28 @@ def chrome_trace(extra: Sequence[Span] = ()) -> dict:
         args["id"] = s.id
         if s.parent_id is not None:
             args["parent"] = s.parent_id
+        if s.trace_id:
+            args["trace"] = f"{s.trace_id:016x}"
         ev["args"] = args
         events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def span_dict(s: Span) -> dict:
+    """One span as the cluster collector exports it: epoch-based
+    microsecond timestamps (comparable across processes), hex ids."""
+    d = {"name": s.name,
+         "ts_us": round((s.t0 + EPOCH_OFFSET) * 1e6, 3),
+         "dur_us": round(s.dur * 1e6, 3),
+         "id": f"{s.id:016x}",
+         "tid": s.tid}
+    if s.parent_id:
+        d["parent"] = f"{s.parent_id:016x}"
+    if s.trace_id:
+        d["trace"] = f"{s.trace_id:016x}"
+    if s.tags:
+        d["tags"] = {k: str(v) for k, v in s.tags.items()}
+    return d
 
 
 def chrome_trace_json() -> str:

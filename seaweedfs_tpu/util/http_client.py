@@ -29,6 +29,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from seaweedfs_tpu.resilience import breaker, deadline, failpoint
+from seaweedfs_tpu.stats import cluster_trace as _ctrace
 from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
 
 _pool_lock = threading.Lock()
@@ -212,28 +213,46 @@ def request(method: str, url: str, body: Optional[bytes] = None,
         merged = dict(headers) if headers else {}
         merged[deadline.HEADER] = f"{rem:.4f}"
         headers = merged
-    if breaker.enabled:
-        breaker.check(netloc)   # raises BreakerOpen while open
+    tsp = None
+    if _ctrace._enabled:
+        from seaweedfs_tpu.stats import trace as _trace
+        if _trace.request_ctx() is not None:
+            # client-side hop span opened FIRST so the remote request
+            # span (minted by the peer's ingress wrapper from this
+            # header) nests under it in the stitched view
+            tsp = _trace.Span("http.client",  None,
+                              {"peer": netloc, "method": method})
+            tsp.__enter__()
+            merged = dict(headers) if headers else {}
+            merged[_ctrace.HEADER] = _ctrace.outbound_header()
+            headers = merged
     try:
-        resp = _request_once_retried(netloc, path, method, body, headers,
-                                     timeout, pooled)
-    except deadline.DeadlineExceeded:
-        # a spent budget says nothing about the PEER's health
-        raise
-    except OSError as e:
-        # ...and neither does a timeout the budget SHRANK below the
-        # caller's own: a healthy-but-slower-than-the-budget peer must
-        # not have its breaker opened by impatient clients
-        if breaker.enabled and not (budget_shrunk and
-                                    isinstance(e, RequestTimeout)):
-            breaker.record(netloc, False)
-        raise
-    if breaker.enabled:
-        breaker.record(netloc, True)
-    if failpoint._armed:
-        resp.body = failpoint.mangle("http.response", resp.body,
-                                     peer=netloc, status=str(resp.status))
-    return resp
+        if breaker.enabled:
+            breaker.check(netloc)   # raises BreakerOpen while open
+        try:
+            resp = _request_once_retried(netloc, path, method, body,
+                                         headers, timeout, pooled)
+        except deadline.DeadlineExceeded:
+            # a spent budget says nothing about the PEER's health
+            raise
+        except OSError as e:
+            # ...and neither does a timeout the budget SHRANK below the
+            # caller's own: a healthy-but-slower-than-the-budget peer
+            # must not have its breaker opened by impatient clients
+            if breaker.enabled and not (budget_shrunk and
+                                        isinstance(e, RequestTimeout)):
+                breaker.record(netloc, False)
+            raise
+        if breaker.enabled:
+            breaker.record(netloc, True)
+        if failpoint._armed:
+            resp.body = failpoint.mangle("http.response", resp.body,
+                                         peer=netloc,
+                                         status=str(resp.status))
+        return resp
+    finally:
+        if tsp is not None:
+            tsp.__exit__(None, None, None)
 
 
 def _request_once_retried(netloc: str, path: str, method: str,

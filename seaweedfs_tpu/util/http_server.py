@@ -204,6 +204,9 @@ class FastHandler(BaseHTTPRequestHandler):
         asked for it, no body on HEAD. (Go's net/http writes its
         response head the same single-buffer way.)"""
         reason = _REASONS.get(code, "")
+        # mirrored by the instrumented send_response hook: the cluster
+        # tracer's tail sampler keeps 5xx requests by final status
+        self.last_status = code
         parts = [f"HTTP/1.1 {code} {reason}\r\nDate: {http_date()}\r\n"]
         if ctype:
             parts.append(f"Content-Type: {ctype}\r\n")

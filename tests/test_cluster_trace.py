@@ -1,0 +1,476 @@
+"""Cluster tracing & flight recorder (ISSUE 7).
+
+Unit layer: header codec, tail-sampling keep/drop, contextvar flow
+through FanOutPool / hedged fetches, live request table, exemplars,
+the stitcher. E2E layer: an in-process filer + 2-replica cluster where
+one stalled PUT yields a stitched Chrome trace spanning the filer,
+the primary volume server and the replica under ONE trace id, shows up
+in /debug/requests mid-stall, and leaves heat telemetry on the read
+path — with byte-identical responses throughout.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.resilience import failpoint
+from seaweedfs_tpu.stats import cluster_trace, trace
+from seaweedfs_tpu.util.fanout import FanOutPool
+from tests.cluster_util import Cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    yield
+    cluster_trace.disable()
+    cluster_trace.reset()
+    failpoint.disarm()
+
+
+def _enable(slow_ms=200.0, sample=0.0):
+    cluster_trace.enable(sample_fraction=sample, slow_threshold_ms=slow_ms)
+
+
+# -- header codec -------------------------------------------------------------
+
+
+def test_header_roundtrip():
+    v = cluster_trace.format_header(0xdead00beef, 0x1234, head=False)
+    assert cluster_trace.parse_header(v) == (0xdead00beef, 0x1234, False)
+    v = cluster_trace.format_header(7, 9, head=True)
+    assert cluster_trace.parse_header(v) == (7, 9, True)
+
+
+@pytest.mark.parametrize("junk", [
+    None, "", "zzz", "12", "12-xx", "0-5", "--", "12345", b"\xff\xfe"])
+def test_header_junk_tolerated(junk):
+    assert cluster_trace.parse_header(junk) is None
+
+
+def test_span_ids_are_64bit_process_unique():
+    a, b = trace.next_span_id(), trace.next_span_id()
+    assert a != b
+    assert a.bit_length() > 32, "ids must carry the process-random word"
+    assert a < 1 << 64 and b < 1 << 64
+
+
+# -- ingress / tail sampling --------------------------------------------------
+
+
+def test_begin_generates_trace_id_without_header():
+    _enable()
+    ctx = cluster_trace.begin("volumeServer", "get", "/1,ab", None,
+                              peer="127.0.0.1", server="v:1")
+    assert ctx.trace_id != 0
+    assert trace.request_ctx() is ctx
+    cluster_trace.finish(ctx)
+    assert trace.request_ctx() is None
+
+
+def test_begin_adopts_header_identity():
+    _enable()
+    ctx = cluster_trace.begin(
+        "volumeServer", "get", "/1,ab",
+        cluster_trace.format_header(0xabc, 0xdef), server="v:1")
+    assert ctx.trace_id == 0xabc
+    assert ctx._span.parent_id == 0xdef
+    cluster_trace.finish(ctx)
+
+
+def test_tail_sampling_keeps_slow_and_drops_fast():
+    _enable(slow_ms=40.0)
+    # fast request: dropped (but still recoverable via the recent ring)
+    ctx = cluster_trace.begin("f", "get", "/a", None, server="s:1")
+    assert cluster_trace.finish(ctx) is None
+    # slow request: kept, returns the exemplar trace id
+    ctx = cluster_trace.begin("f", "get", "/b", None, server="s:1")
+    time.sleep(0.06)
+    kept = cluster_trace.finish(ctx)
+    assert kept == ctx.trace_hex()
+    assert any(t["trace_id"] == kept
+               for t in cluster_trace.sampled_traces())
+
+
+def test_tail_threshold_tracks_per_verb_p95():
+    _enable(slow_ms=0.0)   # floor off: the tracked p95 IS the threshold
+    durs = []
+    for _ in range(40):
+        ctx = cluster_trace.begin("f", "head", "/x", None, server="s:1")
+        durs.append(cluster_trace.finish(ctx) is not None)
+    # uniform sub-ms requests: once the window fills, most requests sit
+    # under their own p95 and drop — tail sampling, not keep-everything
+    assert durs.count(False) > 30
+    ctx = cluster_trace.begin("f", "head", "/y", None, server="s:1")
+    time.sleep(0.05)   # 50 ms vs a sub-ms p95: kept
+    assert cluster_trace.finish(ctx) is not None
+
+
+def test_errors_always_kept():
+    _enable(slow_ms=10_000.0)
+    ctx = cluster_trace.begin("f", "post", "/a", None, server="s:1")
+    assert cluster_trace.finish(ctx, exc=RuntimeError("boom")) is not None
+    ctx = cluster_trace.begin("f", "post", "/a", None, server="s:1")
+    assert cluster_trace.finish(ctx, status=503) is not None
+    ctx = cluster_trace.begin("f", "post", "/a", None, server="s:1")
+    assert cluster_trace.finish(ctx, status=201) is None
+
+
+def test_head_sample_bit_rides_header_and_keeps():
+    _enable(slow_ms=10_000.0)
+    hdr = cluster_trace.format_header(0x77, 0x1, head=True)
+    ctx = cluster_trace.begin("f", "get", "/a", hdr, server="s:1")
+    assert ctx.head
+    assert cluster_trace.finish(ctx) is not None   # fast but head-kept
+    # and the bit propagates onward
+    ctx = cluster_trace.begin("f", "get", "/a", hdr, server="s:1")
+    out = cluster_trace.outbound_header()
+    assert out is not None and out.endswith("-s")
+    cluster_trace.finish(ctx)
+
+
+def test_spans_for_recovers_dropped_recent_requests():
+    """The stitching guarantee: a FAST downstream hop's spans are still
+    fetchable right after it finished, even though tail sampling
+    dropped it — the grace ring."""
+    _enable(slow_ms=10_000.0)
+    hdr = cluster_trace.format_header(0xbeef, 0x1)
+    ctx = cluster_trace.begin("v", "get", "/1,ab", hdr, server="v:1")
+    with trace.span("disk.read", vid=1):
+        pass
+    assert cluster_trace.finish(ctx) is None       # dropped
+    spans = cluster_trace.spans_for("beef")
+    names = [s["name"] for s in spans]
+    assert "request.v.get" in names and "disk.read" in names
+    assert all(s["trace"] == f"{0xbeef:016x}" for s in spans)
+
+
+# -- contextvar flow ----------------------------------------------------------
+
+
+def test_spans_flow_through_fanout_pool():
+    _enable(slow_ms=10_000.0)
+    pool = FanOutPool(2, "trace-test")
+    ctx = cluster_trace.begin("f", "post", "/a", None, server="s:1")
+
+    def work():
+        with trace.span("worker.op", k=1):
+            time.sleep(0.01)
+        return 42
+
+    futs = [pool.submit(work) for _ in range(3)]
+    assert all(f.wait()[0] == 42 for f in futs)
+    cluster_trace.finish(ctx)
+    workers = [s for s in ctx.buf if s.name == "worker.op"]
+    assert len(workers) == 3
+    for s in workers:
+        assert s.trace_id == ctx.trace_id
+        # cross-thread spans parent to the request span
+        assert s.parent_id == ctx.span_id
+    pool.stop()
+
+
+def test_spans_flow_through_hedged_fetch():
+    from seaweedfs_tpu.resilience import Hedger
+    _enable(slow_ms=10_000.0)
+    h = Hedger(name="trace-hedge-test")
+    ctx = cluster_trace.begin("f", "get", "/a", None, server="s:1")
+    assert h.fetch([lambda: "primary"]) == "primary"
+
+    def fail():
+        raise OSError("dead")
+
+    assert h.fetch([fail, lambda: "failover"]) == "failover"
+    cluster_trace.finish(ctx)
+    names = [s.name for s in ctx.buf]
+    assert names.count("hedge.fetch") == 2
+    assert all(s.trace_id == ctx.trace_id for s in ctx.buf)
+
+
+def test_outbound_header_uses_innermost_span_as_parent():
+    _enable(slow_ms=10_000.0)
+    ctx = cluster_trace.begin("f", "get", "/a", None, server="s:1")
+    with trace.span("client.hop") as sp:
+        out = cluster_trace.parse_header(cluster_trace.outbound_header())
+        assert out == (ctx.trace_id, sp.id, False)
+    # outside any span the request span is the parent
+    out = cluster_trace.parse_header(cluster_trace.outbound_header())
+    assert out == (ctx.trace_id, ctx.span_id, False)
+    cluster_trace.finish(ctx)
+
+
+def test_span_buffer_is_bounded():
+    _enable(slow_ms=10_000.0)
+    ctx = cluster_trace.begin("f", "get", "/a", None, server="s:1")
+    for _ in range(cluster_trace.MAX_SPANS_PER_REQUEST + 50):
+        with trace.span("tiny"):
+            pass
+    cluster_trace.finish(ctx)
+    assert len(ctx.buf) == cluster_trace.MAX_SPANS_PER_REQUEST
+    assert ctx.dropped == 50
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_live_request_table():
+    from seaweedfs_tpu.resilience import deadline
+    _enable(slow_ms=10_000.0)
+    with deadline.budget(9.0):
+        ctx = cluster_trace.begin("volumeServer", "get", "/3,ab", None,
+                                  peer="10.0.0.9", server="v:80")
+        with trace.span("ec.reconstruct"):
+            rows = cluster_trace.live_requests()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["trace_id"] == ctx.trace_hex()
+    assert r["verb"] == "get" and r["peer"] == "10.0.0.9"
+    assert r["current_span"] == "ec.reconstruct"
+    assert 0 < r["deadline_left_ms"] <= 9000
+    assert r["age_ms"] >= 0
+    cluster_trace.finish(ctx)
+    assert cluster_trace.live_requests() == []
+
+
+def test_exemplar_rendered_on_histogram():
+    from seaweedfs_tpu.stats.metrics import Histogram
+    h = Histogram("test_exemplar_seconds", "t", buckets=(0.1, 1.0))
+    child = h.labels()
+    child.observe(0.05)
+    assert "# {trace_id=" not in h.collect(openmetrics=True)
+    child.observe_exemplar(0.05, "cafe0000cafe0000")
+    text = h.collect(openmetrics=True)
+    assert '# {trace_id="cafe0000cafe0000"} 0.050000' in text
+    # counts unaffected by the exemplar path
+    assert 'le="0.1"} 2' in text
+    # the classic 0.0.4 exposition stays exemplar-free: a strict
+    # Prometheus text parser would fail the whole scrape on '#' after
+    # the sample value
+    assert "# {trace_id=" not in h.collect()
+
+
+def test_metrics_endpoint_exemplar_opt_in():
+    """Default scrapes — INCLUDING ones carrying Prometheus's stock
+    openmetrics Accept header — stay plain 0.0.4 text (a default
+    scraper must never receive syntax its parser rejects); exemplars
+    appear only on the explicit ?exemplars=1 opt-in."""
+    import urllib.request
+
+    from seaweedfs_tpu.stats.metrics import (RequestHistogram,
+                                             start_metrics_server)
+    RequestHistogram.labels("gate", "om").observe_exemplar(
+        0.004, "feed0000feed0000")
+    srv = start_metrics_server(0, ip="127.0.0.1", role="test")
+    try:
+        url = "http://127.0.0.1:%d/metrics" % srv.server_address[1]
+        plain = urllib.request.urlopen(url, timeout=5)
+        assert "version=0.0.4" in plain.headers["Content-Type"]
+        assert "# {trace_id=" not in plain.read().decode()
+        req = urllib.request.Request(url, headers={
+            "Accept": "application/openmetrics-text;version=1.0.0,"
+                      "text/plain;version=0.0.4;q=0.5"})
+        negotiated = urllib.request.urlopen(req, timeout=5)
+        assert "version=0.0.4" in negotiated.headers["Content-Type"]
+        assert "# {trace_id=" not in negotiated.read().decode()
+        opted = urllib.request.urlopen(url + "?exemplars=1", timeout=5)
+        assert '# {trace_id="feed0000feed0000"}' in opted.read().decode()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# -- heat --------------------------------------------------------------------
+
+
+def test_heat_tracker_window_and_hot_needles():
+    from seaweedfs_tpu.stats import heat
+    tr = heat.HeatTracker(window_s=60.0, needle_sample=1, top_n=4)
+    try:
+        for _ in range(10):
+            tr.record(3, 0xaa)
+        tr.record(3, 0xbb)
+        assert tr.window_reads(3) == 11
+        assert tr.window_reads(99) == 0
+        hot = dict(map(tuple, tr.hot_needles(3)))
+        assert hot["aa"] == 10 and hot["bb"] == 1
+        snap = tr.snapshot()
+        assert snap["volumes"]["3"]["reads_window"] == 11
+        assert snap["volumes"]["3"]["reads_total"] == 11
+    finally:
+        tr.close()
+
+
+def test_heat_gauge_sums_live_trackers_and_forgets_closed():
+    from seaweedfs_tpu.stats import heat
+    a = heat.HeatTracker()
+    b = heat.HeatTracker()
+    try:
+        a.record(42, 1)
+        b.record(42, 1)
+        b.record(42, 2)
+        # the registry-level reader sums across live trackers (two
+        # in-process volume servers holding replicas of one vid)
+        assert heat._vid_reads(42) == 3.0
+        b.close()
+        assert heat._vid_reads(42) == 1.0, \
+            "a closed tracker must stop contributing immediately"
+    finally:
+        a.close()
+        b.close()
+
+
+# -- stitcher ----------------------------------------------------------------
+
+
+def test_stitch_dedupes_and_groups_by_server():
+    from seaweedfs_tpu.shell.command_misc import stitch_chrome_trace
+    a = {"name": "request.filer.post", "id": "01", "ts_us": 10,
+         "dur_us": 100, "tid": 1, "trace": "aa", "role": "filer",
+         "server": "f:1"}
+    b = {"name": "request.volumeServer.post", "id": "02", "ts_us": 20,
+         "dur_us": 50, "tid": 2, "trace": "aa", "parent": "01",
+         "role": "volumeServer", "server": "v:1"}
+    stitched = stitch_chrome_trace([[a, b], [b]])   # b answered twice
+    xs = [e for e in stitched["traceEvents"] if e["ph"] == "X"]
+    ms = [e for e in stitched["traceEvents"] if e["ph"] == "M"]
+    assert len(xs) == 2, "duplicate span ids must collapse"
+    assert {m["args"]["name"] for m in ms} == \
+        {"filer f:1", "volumeServer v:1"}
+    child = next(e for e in xs if e["name"] == "request.volumeServer.post")
+    assert child["args"]["parent"] == "01"
+
+
+# -- E2E ----------------------------------------------------------------------
+
+
+def _get_json(url, timeout=10.0):
+    with urllib.request.urlopen(f"http://{url}", timeout=timeout) as r:
+        return json.load(r)
+
+
+def test_cluster_trace_end_to_end(tmp_path):
+    """The acceptance scenario: one stalled filer PUT -> stitched
+    Chrome trace spanning filer + primary + replica under one trace
+    id, visible in /debug/requests mid-stall, heat telemetry on the
+    read path, byte-identical responses."""
+    from seaweedfs_tpu.shell import Shell
+    _enable(slow_ms=50.0)
+    c = Cluster(tmp_path, n_volume_servers=2, with_filer=True,
+                racks=["r1", "r2"],
+                volume_kwargs={"heat_track": True})
+    try:
+        body = b"trace-me " * 1500
+        tid_hex = f"{0x5eed0000c0ffee01:016x}"
+        hdr = {cluster_trace.HEADER: f"{tid_hex}-{'0' * 16}"}
+
+        # stall every volume write so the PUT is slow end to end
+        failpoint.arm("backend.write_at", "delay", arg=0.25)
+        put_done = threading.Event()
+        put_err = []
+
+        def put():
+            try:
+                with c.http(f"{c.filer.url}/d/slow.bin", data=body,
+                            method="POST",
+                            headers={**hdr, "Content-Type":
+                                     "application/octet-stream"},
+                            timeout=30) as r:
+                    assert r.status == 201
+            except Exception as e:   # noqa: BLE001 - surfaced below
+                put_err.append(e)
+            finally:
+                put_done.set()
+
+        t = threading.Thread(target=put)
+        t.start()
+        # mid-stall: the flight recorder must show the request in
+        # flight with our trace id
+        saw_live = None
+        for _ in range(200):
+            rows = _get_json(f"{c.metrics_url}/debug/requests")["requests"]
+            match = [r for r in rows if r["trace_id"] == tid_hex]
+            if match:
+                saw_live = match
+                break
+            if put_done.is_set():
+                break
+            time.sleep(0.01)
+        t.join(timeout=30)
+        failpoint.disarm()
+        assert not put_err, put_err
+        assert saw_live, "/debug/requests never showed the stalled PUT"
+        assert saw_live[0]["verb"] == "post"
+
+        # replication 010: the file's chunk must exist on BOTH servers
+        # and the stalled write was slow enough to be tail-kept
+        # everywhere it ran. Collect via the metrics-port collector...
+        spans = _get_json(
+            f"{c.metrics_url}/debug/trace?trace_id={tid_hex}")["spans"]
+        assert spans, "collector lost the trace"
+        servers = {(s["role"], s["server"]) for s in spans
+                   if s["name"].startswith("request.")}
+        roles = {r for r, _ in servers}
+        assert "filer" in roles and "volumeServer" in roles
+        vol_servers = {s for r, s in servers if r == "volumeServer"}
+        assert len(vol_servers) == 2, \
+            f"expected primary+replica request spans, got {servers}"
+        assert all(s["trace"] == tid_hex for s in spans)
+
+        # ...and as one stitched Chrome trace via the shell command
+        out_path = str(tmp_path / "stitched.json")
+        sh = Shell(c.master.url, filer_url=c.filer.url)
+        out = sh.run_command(
+            f"cluster.trace -traceId={tid_hex} -out={out_path}")
+        assert "spans across" in out
+        with open(out_path) as f:
+            stitched = json.load(f)
+        procs = [e["args"]["name"] for e in stitched["traceEvents"]
+                 if e["ph"] == "M"]
+        assert len(procs) >= 3, \
+            f"stitched trace must span >=3 processes, got {procs}"
+        xs = [e for e in stitched["traceEvents"] if e["ph"] == "X"]
+        assert all(e["args"].get("trace") == tid_hex for e in xs
+                   if e["name"].startswith("request."))
+
+        # byte-identical read-back, which also heats the volume
+        with c.http(f"{c.filer.url}/d/slow.bin", timeout=30) as r:
+            assert r.read() == body
+        metrics_text = urllib.request.urlopen(
+            f"http://{c.metrics_url}/metrics", timeout=10).read().decode()
+        heat_lines = [l for l in metrics_text.splitlines()
+                      if l.startswith("SeaweedFS_volume_heat{")]
+        assert heat_lines and any(
+            float(l.rsplit(" ", 1)[1]) > 0 for l in heat_lines), \
+            f"volume heat never incremented: {heat_lines}"
+        # Heat block on the primary's /status
+        status = _get_json(f"{c.volume_servers[0].url}/status")
+        heat_blocks = [
+            _get_json(f"{vs.url}/status")["Heat"]
+            for vs in c.volume_servers]
+        assert any(h["enabled"] and h["volumes"] for h in heat_blocks), \
+            f"no Heat block populated: {heat_blocks}"
+        assert status["Heat"]["enabled"]
+
+        # the flight recorder table also answers on role data ports
+        role_rows = _get_json(f"{c.volume_servers[0].url}/debug/requests")
+        assert "requests" in role_rows
+    finally:
+        failpoint.disarm()
+        c.stop()
+
+
+def test_cluster_trace_disabled_requests_untouched(tmp_path):
+    """With the tracer OFF (the default), requests carry no trace
+    header and responses are byte-identical to the enabled run's."""
+    assert not cluster_trace.enabled()
+    c = Cluster(tmp_path, n_volume_servers=1)
+    try:
+        fid = c.upload(b"plain payload")
+        with c.fetch(fid) as r:
+            assert r.read() == b"plain payload"
+        rows = _get_json(f"{c.metrics_url}/debug/requests")["requests"]
+        assert rows == []
+    finally:
+        c.stop()

@@ -551,6 +551,68 @@ def test_breaker_hedge_deadline_disabled_overhead(tmp_path):
         "single-candidate fetches must stay on the caller thread"
 
 
+def test_cluster_trace_disabled_overhead(tmp_path):
+    """Cluster tracing + heat telemetry must be zero-cost while
+    disabled (ISSUE 7 tentpole contract, the tracing/failpoint twin
+    for the cross-hop observability layer).
+
+    Gates. Defaults: the cluster tracer is off (module flag) and a
+    default-config volume server holds NO heat tracker — the read
+    path's heat branch is a None check. Micro: the ingress/egress seam
+    pattern (`if cluster_trace._enabled:` + the disabled span() check)
+    over 200k iterations stays far under a microsecond each. Threads:
+    enabling and disabling the tracer spawns NOTHING — it is pure data
+    structures; threads appear never, not merely "not until first
+    sampled trace"."""
+    import threading
+
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.stats import cluster_trace, trace
+
+    assert not cluster_trace.enabled(), \
+        "cluster tracing must be off by default"
+    assert not trace._cluster_enabled
+
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)])
+    assert vs.heat is None, \
+        "default-config volume server must not construct a heat tracker"
+    vs.store.close()
+
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if cluster_trace._enabled:
+            raise AssertionError("tracer unexpectedly enabled")
+        trace.span("hot", vid=1)
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 5e-6, \
+        f"disabled cluster-trace seam costs {per_call * 1e6:.2f} us/call"
+
+    before = {t.name for t in threading.enumerate()}
+    try:
+        cluster_trace.enable(sample_fraction=0.0, slow_threshold_ms=50)
+        ctx = cluster_trace.begin("gate", "get", "/x", None, server="g:1")
+        cluster_trace.finish(ctx)
+        assert {t.name for t in threading.enumerate()} == before, \
+            "cluster tracing must never spawn threads"
+    finally:
+        cluster_trace.disable()
+        cluster_trace.reset()
+
+    # heat tracker: construction spawns nothing; record() holds a
+    # generous per-call ceiling (it is a few list/dict ops)
+    from seaweedfs_tpu.stats.heat import HeatTracker
+    tr = HeatTracker()
+    assert {t.name for t in threading.enumerate()} == before
+    t0 = time.perf_counter()
+    for i in range(100_000):
+        tr.record(7, i & 0xFF)
+    per_call = (time.perf_counter() - t0) / 100_000
+    assert per_call < 10e-6, \
+        f"heat record costs {per_call * 1e6:.2f} us/call"
+
+
 def test_scrub_disabled_overhead(tmp_path):
     """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
     test_tracing_disabled_overhead twin for the integrity subsystem).
