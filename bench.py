@@ -901,7 +901,49 @@ def chaos_sweep() -> dict:
     return out
 
 
+def lint_bench() -> dict:
+    """--lint mode (ISSUE 8): time the full-tree house-rules analyzer
+    pass. The contract is < 30 s on the 2-core CI VM — cheap enough
+    that every PR runs it as a tier-1 test; the bench records the
+    actual cost (best of 3) and the per-check finding counts at HEAD.
+    """
+    from seaweedfs_tpu.analysis import check_names, run
+
+    times = []
+    findings = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        findings = run()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    per_check = {}
+    for f in findings:
+        per_check[f.check] = per_check.get(f.check, 0) + 1
+    out = {
+        "metric": "lint_full_tree_seconds",
+        "value": round(best, 3),
+        "unit": "s",
+        "budget_s": 30.0,
+        "within_budget": best < 30.0,
+        "runs": [round(t, 3) for t in times],
+        "checks": sorted(check_names()),
+        "findings_total": len(findings),
+        "findings_per_check": per_check,
+    }
+    if not out["within_budget"]:
+        raise SystemExit(
+            f"lint pass took {best:.1f}s — over the 30s tier-1 budget")
+    return out
+
+
 def main() -> None:
+    if "--lint" in sys.argv:
+        line = lint_bench()
+        with open(os.path.join(REPO_ROOT, "BENCH_LINT.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
     if "--chaos" in sys.argv:
         line = chaos_sweep()
         with open(os.path.join(REPO_ROOT, "BENCH_CHAOS.json"),

@@ -23,3 +23,12 @@ Layer map (mirrors SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if _os.environ.get("SEAWEED_SANITIZE"):
+    # arm the runtime concurrency sanitizer BEFORE any submodule
+    # creates its module-level locks, so they are wrapped too; when
+    # the env var is unset this whole block is one dict lookup
+    # (test_perf_gates.test_sanitizer_disabled_overhead)
+    from seaweedfs_tpu.util import sanitizer as _sanitizer  # noqa: F401

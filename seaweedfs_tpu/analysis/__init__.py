@@ -1,0 +1,61 @@
+"""House-rules invariant analyzer — `go vet` for this tree.
+
+The reference implementation gets `go vet`, the race detector, and a
+deadlock-revealing scheduler free from the Go toolchain; this package
+is the Python-side stand-in. It walks every module under
+`seaweedfs_tpu/` and enforces the repo's concurrency and hygiene house
+rules as named, allowlistable AST checks (engine.py / invariants.py /
+deadcode.py — catalog in ARCHITECTURE.md "Static analysis &
+sanitizers"), paired with the runtime half in `util/sanitizer.py`
+(lock-order cycles + hold-time watchdog, armed by SEAWEED_SANITIZE=1).
+
+Runs as tier-1 tests (tests/test_static_analysis.py) so every future
+PR is checked, and as `bench.py --lint` for the timing gate (< 30 s
+full-tree on the 2-core VM).
+
+Fix changelog — findings these tools surfaced that were fixed rather
+than allowlisted (ISSUE 8 satellite; one line each):
+  - util/http_client.close_all: socket close() moved outside
+    _pool_lock (blocking-under-lock)
+  - resilience/breaker._transition: metrics export (labels/inc/set
+    take each family's child lock) deferred until the breaker's own
+    lock is released (lock-order edge breaker->metric)
+  - resilience/breaker.for_peer: CircuitBreaker constructed outside
+    the registry lock (__init__ exports the CLOSED gauge, which takes
+    the metric family's lock — edge registry->metric)
+  - resilience/hedge.observe: p95 window snapshot copied under the
+    lock, sorted outside it (O(n log n) under the read hot-path lock)
+  - util/log_buffer.LogBuffer: flusher thread now spawns lazily on
+    first add() instead of at construction (gate check)
+  - filer/master/s3api/replication/assign_lease/masterclient: silent
+    `except Exception` swallows now bump
+    SeaweedFS_swallowed_errors_total{site} (11 ledgered sites);
+    storage/disk_location logs the volume it skips
+  - tree-wide: 40 dead imports, 2 dead locals, and a
+    placeholder-less f-string removed (check `dead`)
+
+Usage:
+    python -m seaweedfs_tpu.analysis          # human report, exit 1 on findings
+    from seaweedfs_tpu.analysis import run    # [Finding, ...]
+"""
+
+from __future__ import annotations
+
+from seaweedfs_tpu.analysis.engine import (Finding, check_names,
+                                           run_checks)
+
+__all__ = ["Finding", "run", "check_names"]
+
+
+def run(checks=None):
+    """Run the analyzer over the package; returns list[Finding]."""
+    return run_checks(checks=checks)
+
+
+def main() -> int:
+    findings = run()
+    for f in findings:
+        print(f)
+    print(f"{len(findings)} finding(s) across "
+          f"{len(check_names())} checks")
+    return 1 if findings else 0

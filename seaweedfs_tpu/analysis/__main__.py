@@ -1,0 +1,5 @@
+import sys
+
+from seaweedfs_tpu.analysis import main
+
+sys.exit(main())

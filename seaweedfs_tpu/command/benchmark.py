@@ -121,6 +121,7 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
                 wstats.fail()
 
     t0 = time.monotonic()
+    # lint: thread-ok(benchmark load thread is its own request; stats.fail accounts errors)
     threads = [threading.Thread(target=writer, daemon=True)
                for _ in range(concurrency)]
     for th in threads:
@@ -162,6 +163,7 @@ def run_benchmark_programmatic(master: str, n: int = 1024,
                     rstats.fail()
 
         t0 = time.monotonic()
+        # lint: thread-ok(benchmark load thread is its own request; stats.fail accounts errors)
         threads = [threading.Thread(target=reader, daemon=True)
                    for _ in range(concurrency)]
         for th in threads:

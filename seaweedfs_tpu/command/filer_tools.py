@@ -125,6 +125,7 @@ def run_filer_copy(args) -> int:
             failed.append((local, e))
             print(f"{local}: {e}", file=sys.stderr)
 
+    # lint: thread-ok(offline CLI copy tool; no server request context exists)
     with ThreadPoolExecutor(max_workers=max(1, opts.concurrency)) as pool:
         list(pool.map(copy_one, jobs))
     return 1 if failed else 0

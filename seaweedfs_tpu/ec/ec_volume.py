@@ -51,6 +51,7 @@ def _get_recover_pool() -> ThreadPoolExecutor:
     if _recover_pool is None:
         with _recover_pool_lock:
             if _recover_pool is None:
+                # lint: thread-ok(shared recover pool takes explicit work items; the read seam enforces deadlines)
                 _recover_pool = ThreadPoolExecutor(
                     max_workers=8, thread_name_prefix="ec-recover")
     return _recover_pool
@@ -269,7 +270,8 @@ class EcVolume:
         if remote_reader is not None:
             try:
                 data = remote_reader(shard_id, off, iv.size)
-            except Exception:  # a dead peer demotes to reconstruction
+            # lint: swallow-ok(failure demotes to RS reconstruction, counted by SeaweedFS_reads_degraded_total)
+            except Exception:
                 data = None
             if data is not None and len(data) == iv.size:
                 return data
@@ -349,7 +351,8 @@ class EcVolume:
                     break
                 try:
                     b = fut.result()
-                except Exception:  # a dead peer fails rows, not reads
+                # lint: swallow-ok(a dead peer fails rows, not reads; deficit rows top up below)
+                except Exception:
                     b = None
                 if b is not None and len(b) == length:
                     ids.append(sid)

@@ -5,7 +5,7 @@ Reference: weed/storage/erasure_coding/ec_volume_info.go:61-113.
 
 from __future__ import annotations
 
-from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS
+from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, TOTAL_SHARDS
 
 
 class ShardBits(int):

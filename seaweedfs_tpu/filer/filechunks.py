@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, replace
-from typing import Callable, Iterable, List, Optional
+from typing import Iterable, List, Optional
 
 from seaweedfs_tpu.pb import filer_pb2
 

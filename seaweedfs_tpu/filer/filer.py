@@ -7,7 +7,7 @@ filer_rename.go, filer_delete_entry.go, filer_buckets.go).
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional
 
 from seaweedfs_tpu.filer import filechunk_manifest, filechunks
 from seaweedfs_tpu.filer import filer_notify as filer_notify_mod
@@ -93,7 +93,9 @@ class Filer:
                 chunks = filechunk_manifest.resolve_chunk_manifest(
                     self.fetch_chunk_fn, list(chunks)) + manifests
             except Exception:
-                pass  # delete what we can rather than fail the namespace op
+                # delete what we can rather than fail the namespace op
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("filer.resolve_manifest")
         self.on_delete_chunks(chunks)
 
     # -- event log ------------------------------------------------------------
@@ -127,15 +129,19 @@ class Filer:
             try:
                 self.on_meta_event()  # wake merged-view subscribers
             except Exception:
-                pass  # the merged view is best-effort; local log is canonical
+                # the merged view is best-effort; local log is canonical
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("filer.meta_event_wake")
         if self.notification_queue is not None:
             try:
                 self.notification_queue.send_message(
                     filer_notify_mod.event_key(directory, ev), ev)
             except Exception:
                 # the write already committed; a broken external queue
-                # must not turn it into a client-visible failure
-                pass
+                # must not turn it into a client-visible failure —
+                # but it must be VISIBLE on dashboards
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("filer.notify_queue")
 
     # -- CRUD -----------------------------------------------------------------
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import calendar
 import os
 import time
-from typing import Callable, Iterator, List, Optional
+from typing import List, Optional
 
 from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.util.log_buffer import LogBuffer, LogEntry

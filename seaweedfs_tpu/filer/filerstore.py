@@ -9,12 +9,12 @@ coarse lock.
 
 from __future__ import annotations
 
-import threading
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.stats.metrics import REGISTRY
 
+# lint: metric-ok(reference family name predates the lowercase rule; renaming breaks dashboards)
 FilerStoreCounter = REGISTRY.counter(
     "SeaweedFS_filerStore_request_total", "filer store ops",
     ("store", "op"))

@@ -66,10 +66,12 @@ class MetaAggregator:
 
     def start(self) -> None:
         for peer in self.peers:
+            # lint: thread-ok(per-peer subscription daemon; no request context)
             t = threading.Thread(target=self._follow_peer, args=(peer,),
                                  name=f"meta-aggr-{peer}", daemon=True)
             t.start()
             self._threads.append(t)
+        # lint: thread-ok(per-peer subscription daemon; no request context)
         t = threading.Thread(target=self._checkpoint_loop,
                              name="meta-aggr-checkpoint", daemon=True)
         t.start()
@@ -82,6 +84,7 @@ class MetaAggregator:
         for call in list(self._calls.values()):
             try:
                 call.cancel()
+            # lint: swallow-ok(best-effort cancel during shutdown)
             except Exception:
                 pass
         for t in self._threads:

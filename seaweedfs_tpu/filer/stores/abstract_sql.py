@@ -15,7 +15,7 @@ from __future__ import annotations
 import hashlib
 import struct
 import threading
-from typing import List, Optional
+from typing import List
 
 from seaweedfs_tpu.filer.filerstore import FilerStore, NotFound, normalize_path
 from seaweedfs_tpu.pb import filer_pb2

@@ -89,6 +89,7 @@ class CqlClient:
             self._stream = (self._stream + 1) & 0x7FFF
             frame = struct.pack(">BBhBi", 0x04, 0, self._stream, opcode,
                                 len(body)) + body
+            # lint: block-ok(single-socket wire protocol: the lock IS the request/response serializer)
             self._sock.sendall(frame)
             header = self._read_exact(9)
             _ver, _flags, _stream, r_op, length = struct.unpack(

@@ -134,6 +134,7 @@ class HBaseClient:
                 call_id=self._call_id, method_name=method,
                 request_param=True)
             payload = _delimited(header) + _delimited(request)
+            # lint: block-ok(single-socket wire protocol: the lock IS the request/response serializer)
             self._sock.sendall(struct.pack(">I", len(payload)) + payload)
             (total,) = struct.unpack(">I", self._read_exact(4))
             frame = self._read_exact(total)

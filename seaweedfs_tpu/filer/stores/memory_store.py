@@ -4,7 +4,7 @@ store, and the model for the SPI semantics."""
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from seaweedfs_tpu.filer.filerstore import FilerStore, NotFound, normalize_path
 from seaweedfs_tpu.pb import filer_pb2

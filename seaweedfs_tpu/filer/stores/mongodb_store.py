@@ -143,6 +143,7 @@ class MongoClient:
             body = struct.pack("<I", 0) + b"\x00" + encode_doc(doc)
             msg = struct.pack("<iiii", 16 + len(body), self._req_id, 0,
                               OP_MSG) + body
+            # lint: block-ok(single-socket wire protocol: the lock IS the request/response serializer)
             self._sock.sendall(msg)
             header = self._read_exact(16)
             (length, _, _, opcode) = struct.unpack("<iiii", header)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Optional
+from typing import List
 
 from seaweedfs_tpu.filer.filerstore import (FilerStore, NotFound,
                                             join_path, normalize_path)
@@ -52,6 +52,7 @@ class RespClient:
             out = [b"*%d\r\n" % len(parts)]
             for p in parts:
                 out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+            # lint: block-ok(single-socket wire protocol: the lock IS the request/response serializer)
             self._sock.sendall(b"".join(out))
             return self._read_reply()
 
@@ -88,6 +89,7 @@ class RespClient:
                    b"*%d\r\n" % len(parts)]
             for p in parts:
                 out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+            # lint: block-ok(single-socket wire protocol: the lock IS the request/response serializer)
             self._sock.sendall(b"".join(out))
             self._read_reply()  # +OK for ASKING
             return self._read_reply()

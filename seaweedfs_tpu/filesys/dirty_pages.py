@@ -5,7 +5,7 @@ random writes and reads back the merged view before flush)."""
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 
 @dataclass

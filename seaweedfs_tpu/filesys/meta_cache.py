@@ -5,11 +5,11 @@ invalidated by the filer's SubscribeMetadata stream)."""
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 import grpc
 
-from seaweedfs_tpu.filer.filerstore import (FilerStoreWrapper, NotFound,
+from seaweedfs_tpu.filer.filerstore import (FilerStoreWrapper,
                                             split_path)
 from seaweedfs_tpu.filer.stores.memory_store import MemoryStore
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
@@ -77,6 +77,7 @@ class MetaCache:
     # -- subscription invalidation -------------------------------------------
 
     def start_subscription(self, since_ns: int = 0) -> None:
+        # lint: thread-ok(mount-lifetime invalidation tail; no request context)
         self._sub_thread = threading.Thread(
             target=self._subscribe_loop, args=(since_ns,),
             name="meta-cache-sub", daemon=True)

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from seaweedfs_tpu.filer import filechunks, stream
-from seaweedfs_tpu.filer.filerstore import NotFound, join_path, split_path
+from seaweedfs_tpu.filer.filerstore import NotFound, split_path
 from seaweedfs_tpu.filesys.dirty_pages import ContinuousIntervals
 from seaweedfs_tpu.filesys.meta_cache import MetaCache
 from seaweedfs_tpu.operation import operations

@@ -18,7 +18,7 @@ import socketserver
 import threading
 import urllib.error
 import urllib.request
-from typing import Optional, Tuple
+from typing import Optional
 
 from seaweedfs_tpu.util import wlog
 
@@ -48,6 +48,7 @@ class FtpServer:
         self._server = socketserver.ThreadingTCPServer(
             (self.ip, self.port), Handler, bind_and_activate=True)
         self._server.daemon_threads = True
+        # lint: thread-ok(listener thread; per-session state is minted at accept)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name=f"ftpd-{self.port}",
             daemon=True)

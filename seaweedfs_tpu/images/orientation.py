@@ -29,5 +29,6 @@ def fix_orientation(data: bytes, mime: str = "image/jpeg") -> bytes:
         buf = io.BytesIO()
         out.save(buf, format="JPEG", exif=out.getexif().tobytes())
         return buf.getvalue()
+    # lint: swallow-ok(unparseable/untransposable image served as stored)
     except Exception:
         return data

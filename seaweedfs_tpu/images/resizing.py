@@ -10,7 +10,7 @@ the reference returns the original bytes on decode failure.
 from __future__ import annotations
 
 import io
-from typing import Optional, Tuple
+from typing import Tuple
 
 _FORMATS = {"image/jpeg": "JPEG", "image/png": "PNG", "image/gif": "GIF",
             "image/webp": "WEBP"}
@@ -28,6 +28,7 @@ def resized(data: bytes, mime: str, width: int = 0, height: int = 0,
     try:
         img = Image.open(io.BytesIO(data))
         img.load()
+    # lint: swallow-ok(unparseable image served as stored, undimensioned)
     except Exception:
         return data, 0, 0
     ow, oh = img.size

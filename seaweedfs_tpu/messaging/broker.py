@@ -88,6 +88,7 @@ class MessageBroker:
             # advertise ourselves + owned topics over the filer's
             # KeepConnected stream so LocateBroker finds us (reference
             # broker_server.go keepConnectedToOneFiler)
+            # lint: thread-ok(broker listener thread; no ambient request state)
             self._reg_thread = threading.Thread(
                 target=self._register_loop, name="broker-register",
                 daemon=True)
@@ -184,7 +185,6 @@ class MessageBroker:
             if a.error:
                 return
             operations.upload_data(f"{a.url}/{a.file_id}", frame)
-            seg = self._seg_path(ns, topic, p)
             stub.AppendToEntry(filer_pb2.AppendToEntryRequest(
                 directory=self._topic_dir(ns, topic),
                 entry_name=f"{p:02d}.log",

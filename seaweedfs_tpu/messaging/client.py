@@ -10,7 +10,7 @@ import hashlib
 import queue
 import threading
 import time
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 import grpc
 
@@ -114,6 +114,7 @@ class SubChannel:
                                subscriber_id=subscriber_id)
         self._md5 = hashlib.md5()
         self._q: "queue.Queue" = queue.Queue()
+        # lint: gate-ok(a subscription's pump starts at subscribe: construction is first use) # lint: thread-ok(pump feeds a local queue; no deadline or trace to carry)
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 

@@ -38,6 +38,7 @@ def _try_build() -> None:
             ["make", "-C", src_dir, "-s"],
             check=False, timeout=120,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    # lint: swallow-ok(optional native build; loader falls back to numpy)
     except Exception:
         pass
 

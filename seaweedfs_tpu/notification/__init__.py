@@ -165,6 +165,7 @@ class AsyncQueue(MessageQueue):
         self.failed = 0      # monotonic: sends the backend rejected
         self.last_error: Optional[Exception] = None   # None after success
         self.last_failure: Optional[Exception] = None  # never reset
+        # lint: gate-ok(built only when a notification backend is configured) # lint: thread-ok(async sender is deliberately decoupled from the committing request)
         self._sender = threading.Thread(target=self._run,
                                         name="notify-sender", daemon=True)
         self._sender.start()

@@ -20,7 +20,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from seaweedfs_tpu.notification import MessageQueue
 

@@ -126,11 +126,14 @@ class LeaseCache:
                     first, rest = self._assign_batch(key)
                     self._bank(key, [first] + rest)
             except Exception:
-                pass  # next miss refills synchronously and surfaces it
+                # next miss refills synchronously and surfaces it
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("lease.refill")
             finally:
                 with self._lock:
                     self._refilling.discard(key)
 
+        # lint: thread-ok(refill outlives the triggering request by design; a spent budget must not kill the bank)
         threading.Thread(target=run, daemon=True,
                          name="ingest-lease-refill").start()
 

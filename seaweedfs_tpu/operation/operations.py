@@ -102,7 +102,7 @@ def upload_data(url_fid: str, data: bytes, filename: str = "",
     # collision-proof framing: one urandom prefix per process + a
     # counter (secrets.token_hex per upload costs a getrandom syscall)
     boundary = f"sw-{_BOUNDARY_PREFIX}{next(_boundary_counter):x}"
-    disp = f'form-data; name="file"'
+    disp = 'form-data; name="file"'
     if filename:
         disp += f'; filename="{filename}"'
     part_headers = f"Content-Disposition: {disp}\r\n"

@@ -324,6 +324,7 @@ def fleet_write_ec_files_sharded(base_names: Sequence[str],
         except BaseException as e:
             errors.append(e)
 
+    # lint: thread-ok(one scheduler thread per device for the whole pass; no request context)
     threads = [threading.Thread(target=run, args=(names, dev),
                                 name=f"fleet-shard-{i}")
                for i, (names, dev) in enumerate(zip(shards, devices))]

@@ -103,7 +103,8 @@ def _read_remote(remote_reader, sid: int, offset: int,
                  length: int) -> Optional[bytes]:
     try:
         b = remote_reader(sid, offset, length)
-    except Exception:  # remote fetch must never poison the batch
+    # lint: swallow-ok(remote fetch must never poison the batch; errors latch per request)
+    except Exception:
         return None
     return b if b is not None and len(b) == length else None
 
@@ -113,6 +114,7 @@ def _await_row(fut) -> Optional[bytes]:
     stuck row costs its request a source shard, never the dispatcher."""
     try:
         return fut.result(timeout=FETCH_TIMEOUT_S)
+    # lint: swallow-ok(a wedged row costs a source shard; the decode latches real errors)
     except Exception:
         return None
 
@@ -150,6 +152,7 @@ class DegradedReadFleet:
             if self._dispatcher is not None or self._stopping:
                 return
             self._rs = ReedSolomon(backend=self.backend)
+            # lint: thread-ok(decode fleet pool; decode enforces the deadline on the caller thread)
             self._pool = ThreadPoolExecutor(
                 max_workers=self.readers,
                 thread_name_prefix="reads-fetch")
@@ -160,9 +163,11 @@ class DegradedReadFleet:
             # semaphore mirrors the pool width so the dispatcher can
             # tell when every worker is busy — and keep accumulating
             # instead of queueing micro-batches behind them.
+            # lint: thread-ok(decode batch workers; decode enforces the deadline on the caller thread)
             self._workers = ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="reads-batch")
             self._slots = threading.Semaphore(2)
+            # lint: thread-ok(dispatcher daemon; requests rendezvous on per-request events)
             t = threading.Thread(target=self._run, name="reads-decode",
                                  daemon=True)
             t.start()

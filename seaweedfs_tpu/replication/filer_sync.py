@@ -10,7 +10,6 @@ import threading
 import time
 from typing import Optional
 
-import grpc
 
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
 from seaweedfs_tpu.replication.replicator import Replicator
@@ -31,6 +30,7 @@ class _OneWay:
         self._thread: Optional[threading.Thread] = None
 
     def start(self, since_ns: int) -> None:
+        # lint: thread-ok(replication tail daemon; no request context)
         self._thread = threading.Thread(
             target=self._loop, args=(since_ns,),
             name=f"filer-sync-{self.src_url}", daemon=True)
@@ -61,10 +61,14 @@ class _OneWay:
                     except Exception:
                         # one unreplayable event (e.g. source chunk
                         # already deleted) must not kill the tail
+                        from seaweedfs_tpu.stats import metrics
+                        metrics.swallowed("filer_sync.replicate_event")
                         continue
             except Exception:
                 if self._stopping:
                     return
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("filer_sync.stream")
                 time.sleep(0.2)
 
     def stop(self) -> None:

@@ -3,8 +3,6 @@
 
 from __future__ import annotations
 
-from typing import Optional
-
 from seaweedfs_tpu.filer.filerstore import join_path, split_path
 from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.replication.sinks import ReplicationSink

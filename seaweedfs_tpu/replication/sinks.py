@@ -10,8 +10,7 @@ from typing import Optional
 
 import grpc
 
-from seaweedfs_tpu.filer import http_client as filer_http
-from seaweedfs_tpu.filer.filerstore import join_path, split_path
+from seaweedfs_tpu.filer.filerstore import split_path
 from seaweedfs_tpu.pb import filer_pb2, filer_stub
 
 

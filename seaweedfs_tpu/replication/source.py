@@ -3,11 +3,8 @@
 
 from __future__ import annotations
 
-from typing import List
-
 from seaweedfs_tpu.filer import http_client as filer_http
 from seaweedfs_tpu.filer.filerstore import join_path
-from seaweedfs_tpu.pb import filer_pb2
 
 
 class FilerSource:

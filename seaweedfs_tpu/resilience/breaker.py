@@ -75,59 +75,83 @@ class CircuitBreaker:
 
     @property
     def state(self) -> int:
+        emit: List[int] = []
         with self._lock:
             # surface OPEN->HALF_OPEN lazily so status readers see the
             # recoverable state without waiting for the next request
             if self._state == OPEN and \
                     time.monotonic() - self._opened_at >= self.cooldown_s:
-                self._transition(HALF_OPEN)
-            return self._state
+                self._transition(HALF_OPEN, emit)
+            st = self._state
+        self._emit(emit)
+        return st
 
     def allow(self) -> bool:
         """May a request go to this peer right now? Transitioning
         OPEN -> HALF_OPEN reserves the single probe slot for the
         caller that got True."""
-        with self._lock:
-            if self._state == CLOSED:
-                return True
-            now = time.monotonic()
-            if self._state == OPEN:
-                if now - self._opened_at < self.cooldown_s:
+        emit: List[int] = []
+        try:
+            with self._lock:
+                if self._state == CLOSED:
+                    return True
+                now = time.monotonic()
+                if self._state == OPEN:
+                    if now - self._opened_at < self.cooldown_s:
+                        return False
+                    self._transition(HALF_OPEN, emit)
+                # HALF_OPEN: exactly one probe in flight. A probe whose
+                # caller never called record() — died mid-flight, or
+                # bailed on a spent deadline — is reclaimed after
+                # cooldown_s, or the peer's breaker would wedge open
+                # forever
+                if self._probe_inflight and \
+                        now - self._probe_started < self.cooldown_s:
                     return False
-                self._transition(HALF_OPEN)
-            # HALF_OPEN: exactly one probe in flight. A probe whose
-            # caller never called record() — died mid-flight, or bailed
-            # on a spent deadline — is reclaimed after cooldown_s, or
-            # the peer's breaker would wedge open forever
-            if self._probe_inflight and \
-                    now - self._probe_started < self.cooldown_s:
-                return False
-            self._probe_inflight = True
-            self._probe_started = now
-            return True
+                self._probe_inflight = True
+                self._probe_started = now
+                return True
+        finally:
+            self._emit(emit)
 
     def record(self, ok: bool) -> None:
+        emit: List[int] = []
         with self._lock:
             self._probe_inflight = False
             if ok:
                 self._consecutive_failures = 0
                 if self._state != CLOSED:
-                    self._transition(CLOSED)
-                return
-            self._consecutive_failures += 1
-            if self._state == HALF_OPEN or (
-                    self._state == CLOSED and
-                    self._consecutive_failures >= self.threshold):
-                self._opened_at = time.monotonic()
-                self._transition(OPEN)
+                    self._transition(CLOSED, emit)
+            else:
+                self._consecutive_failures += 1
+                if self._state == HALF_OPEN or (
+                        self._state == CLOSED and
+                        self._consecutive_failures >= self.threshold):
+                    self._opened_at = time.monotonic()
+                    self._transition(OPEN, emit)
+        self._emit(emit)
 
-    def _transition(self, to: int) -> None:
-        # caller holds self._lock
+    def _transition(self, to: int, emit: List[int]) -> None:
+        # caller holds self._lock; the metrics export is DEFERRED to
+        # _emit after release — labels()/set()/inc() take each family's
+        # child-creation lock, and holding the breaker lock across a
+        # foreign lock is exactly the lock-order edge the sanitizer
+        # (util/sanitizer.py) exists to flag
         self._state = to
-        self._export(to)
+        emit.append(to)
+
+    def _emit(self, transitions: List[int]) -> None:
+        if not transitions:
+            return
         from seaweedfs_tpu.stats.metrics import BreakerTransitionsCounter
-        BreakerTransitionsCounter.labels(self.peer,
-                                         _STATE_NAMES[to]).inc()
+        for to in transitions:
+            BreakerTransitionsCounter.labels(self.peer,
+                                             _STATE_NAMES[to]).inc()
+        # the gauge converges on the breaker's CURRENT state rather
+        # than replaying this call's transition value: two calls whose
+        # emits interleave out of order would otherwise leave the
+        # gauge stale until the next transition (review finding)
+        self._export(self._state)
 
     def _export(self, state: int) -> None:
         from seaweedfs_tpu.stats.metrics import BreakerStateGauge
@@ -162,11 +186,14 @@ def reset() -> None:
 def for_peer(peer: str) -> CircuitBreaker:
     with _lock:
         b = _registry.get(peer)
-        if b is None:
-            b = CircuitBreaker(peer, threshold=_threshold,
-                               cooldown_s=_cooldown_s)
-            _registry[peer] = b
-        return b
+    if b is None:
+        # constructed OUTSIDE the registry lock: __init__ exports the
+        # CLOSED gauge, which takes the metric family's lock
+        b = CircuitBreaker(peer, threshold=_threshold,
+                           cooldown_s=_cooldown_s)
+        with _lock:
+            b = _registry.setdefault(peer, b)
+    return b
 
 
 def check(peer: str) -> None:

@@ -70,10 +70,15 @@ class Hedger:
         with self._lock:
             self._lat.append(seconds)
             self._since_recalc += 1
-            if self._since_recalc >= _RECALC_EVERY:
-                self._since_recalc = 0
-                ordered = sorted(self._lat)
-                self._p95 = ordered[int(0.95 * (len(ordered) - 1))]
+            if self._since_recalc < _RECALC_EVERY:
+                return
+            self._since_recalc = 0
+            snapshot = list(self._lat)
+        # the O(n log n) sort runs OUTSIDE the lock — this lock sits on
+        # every observed read's exit path, and two racing recalcs both
+        # write a fresh-enough estimate (attribute store is atomic)
+        ordered = sorted(snapshot)
+        self._p95 = ordered[int(0.95 * (len(ordered) - 1))]
 
     def hedge_delay(self) -> float:
         """How long the primary runs alone: max(tracked p95, floor)."""

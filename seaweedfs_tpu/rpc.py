@@ -216,6 +216,7 @@ def make_server(address: str, handlers, max_workers: int = 16) -> grpc.Server:
     generic handlers (from generic_handler())."""
     from concurrent import futures
     server = grpc.server(
+        # lint: thread-ok(gRPC server pool; instrument_grpc_method mints request context per call)
         futures.ThreadPoolExecutor(max_workers=max_workers),
         options=[("grpc.max_send_message_length", 64 << 20),
                  ("grpc.max_receive_message_length", 64 << 20),

@@ -15,7 +15,7 @@ import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
 from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import grpc
 
@@ -59,11 +59,13 @@ class S3ApiServer:
     def start(self) -> None:
         self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_handler(self))
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
             name=f"s3-http-{self.port}", daemon=True)
         self._http_thread.start()
         self._reload_dynamic_iam()
+        # lint: thread-ok(iam-watch daemon; no request context)
         self._iam_watcher = threading.Thread(
             target=self._watch_iam, name=f"s3-iam-{self.port}",
             daemon=True)
@@ -93,6 +95,8 @@ class S3ApiServer:
         try:
             status, body, _ = self.filer_get(path)
         except Exception:
+            from seaweedfs_tpu.stats import metrics
+            metrics.swallowed("s3.iam_load")
             return
         if status != 200 or not body:
             return
@@ -133,6 +137,8 @@ class S3ApiServer:
             except Exception:
                 if self._stopping:
                     return
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("s3.iam_watch")
                 time.sleep(0.5)
 
     # -- filer plumbing -------------------------------------------------------
@@ -588,7 +594,6 @@ def _make_handler(s3: S3ApiServer):
 
         def _initiate_multipart(self, bucket: str, key: str):
             upload_id = secrets.token_hex(16)
-            updir = f"{BUCKETS_DIR}/{bucket}/{MULTIPART_DIR}/{upload_id}"
             entry = filer_pb2.Entry(name=upload_id, is_directory=True)
             entry.extended["key"] = key.encode()
             mime = self.headers.get("Content-Type") or ""

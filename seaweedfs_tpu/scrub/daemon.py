@@ -143,6 +143,7 @@ class ScrubDaemon:
                 if throttle_mbps is not None and throttle_mbps > 0 else None
             self._state = "running"
             self._resume.set()
+            # lint: thread-ok(scrub daemon paced by -scrubMBps; no request context)
             self._thread = threading.Thread(
                 target=self._run, name="scrub-daemon", daemon=True)
             self._thread.start()

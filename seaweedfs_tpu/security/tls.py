@@ -12,8 +12,7 @@ ssl_channel_credentials + the client cert pair.
 
 from __future__ import annotations
 
-import os
-from typing import Optional, Tuple
+from typing import Optional
 
 import grpc
 

@@ -9,7 +9,7 @@ layer and stays the source of truth.
 
 from __future__ import annotations
 
-from typing import Iterable, List
+from typing import List
 
 from seaweedfs_tpu.pb import master_pb2
 from seaweedfs_tpu.storage.superblock import TTL

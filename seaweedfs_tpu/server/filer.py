@@ -26,7 +26,7 @@ from seaweedfs_tpu.filer import (Filer, FilerError, MemoryStore, NotFound,
                                  SqliteStore, filechunks, stream)
 from seaweedfs_tpu.filer import filer_conf as filer_conf_mod
 from seaweedfs_tpu.filer.filechunk_manifest import maybe_manifestize
-from seaweedfs_tpu.filer.filer import entry_expired, new_entry
+from seaweedfs_tpu.filer.filer import new_entry
 from seaweedfs_tpu.filer.filerstore import join_path, split_path
 from seaweedfs_tpu.operation import operations
 from seaweedfs_tpu.pb import filer_pb2, master_pb2, master_stub
@@ -288,6 +288,7 @@ class FilerServer:
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
         self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
             name=f"filer-http-{self.port}", daemon=True)
@@ -329,8 +330,11 @@ class FilerServer:
             try:
                 operations.delete_files(self.master_url, fids)
             except Exception:
-                pass  # volumes may already be gone; vacuum will reclaim
+                # volumes may already be gone; vacuum will reclaim
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("filer.delete_chunks")
 
+        # lint: thread-ok(deliberately detached: chunk deletion outlives the client reply)
         threading.Thread(target=run, daemon=True,
                          name="filer-delete-chunks").start()
 

@@ -188,16 +188,19 @@ class MasterServer:
         self.raft.start()
         self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever, name="master-http",
             daemon=True)
         self._http_thread.start()
         if self.maintenance_scripts:
+            # lint: thread-ok(maintenance cron daemon; no request context)
             self._maint_thread = threading.Thread(
                 target=self._maintenance_loop, name="master-maintenance",
                 daemon=True)
             self._maint_thread.start()
         if self.scrub_interval_s > 0:
+            # lint: thread-ok(scrub scheduler daemon; no request context)
             self._scrub_thread = threading.Thread(
                 target=self._scrub_loop, name="master-scrub",
                 daemon=True)
@@ -400,6 +403,7 @@ class MasterServer:
     def _broadcast(self, loc: master_pb2.VolumeLocation) -> None:
         with self._sub_lock:
             for q in self._subscribers.values():
+                # lint: block-ok(unbounded Queue.put never blocks)
                 q.put(loc)
 
     def _full_locations(self) -> List[master_pb2.VolumeLocation]:
@@ -668,7 +672,9 @@ class MasterServer:
                     volume_server_pb2.DeleteCollectionRequest(
                         collection=request.name))
             except Exception:
-                pass  # node down: its heartbeat resync will converge
+                # node down: its heartbeat resync will converge
+                from seaweedfs_tpu.stats import metrics
+                metrics.swallowed("master.collection_delete")
         return master_pb2.CollectionDeleteResponse()
 
     def VolumeList(self, request, context):
@@ -744,13 +750,18 @@ class MasterServer:
                     if self._vacuum_one(vid, replicas, threshold):
                         compacted.append(vid)
                 except Exception:
+                    # failed mid-compaction: best-effort cleanup on
+                    # every replica, and the failure is ledgered
+                    from seaweedfs_tpu.stats import metrics
+                    metrics.swallowed("master.vacuum")
                     for r in replicas:
                         try:
                             volume_stub(r.url).VacuumVolumeCleanup(
                                 volume_server_pb2.VacuumVolumeCleanupRequest(
                                     volume_id=vid))
                         except Exception:
-                            pass
+                            from seaweedfs_tpu.stats import metrics
+                            metrics.swallowed("master.vacuum_cleanup")
         return compacted
 
     def _vacuum_one(self, vid: int, replicas, threshold: float) -> bool:

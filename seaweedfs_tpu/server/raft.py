@@ -105,6 +105,7 @@ class RaftNode:
         self._stopped = False
         self._threads: List[threading.Thread] = []
         self._inflight: set = set()  # peers with a replicate RPC in flight
+        # lint: thread-ok(consensus RPC fan-out pool; raft owns its own timeouts)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, len(self.peers)),
             thread_name_prefix="raft-repl") if self.peers else None
@@ -337,6 +338,7 @@ class RaftNode:
     def start(self) -> None:
         if not self.peers:
             return  # single master: no timers needed
+        # lint: thread-ok(election/heartbeat daemon; no request context)
         t = threading.Thread(target=self._ticker, name="raft-ticker",
                              daemon=True)
         t.start()

@@ -185,10 +185,12 @@ class VolumeServer:
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
         self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_http_handler(self))
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
             name=f"volume-http-{self.port}", daemon=True)
         self._http_thread.start()
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name=f"heartbeat-{self.port}",
             daemon=True)

@@ -46,6 +46,7 @@ class WebDavServer:
     def start(self) -> None:
         self._http_server = TrackingHTTPServer(
             (self.ip, self.port), _make_handler(self))
+        # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
             name=f"webdav-{self.port}", daemon=True)

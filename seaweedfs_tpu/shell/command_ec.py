@@ -10,7 +10,7 @@ volume survives until all 14 shards are spread.
 from __future__ import annotations
 
 import argparse
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from seaweedfs_tpu.ec.shard_bits import ShardBits, DATA_SHARDS, TOTAL_SHARDS
 from seaweedfs_tpu.pb import volume_server_pb2
@@ -96,8 +96,9 @@ def ec_encode(env: CommandEnv, argv: List[str], out) -> None:
                             env.volume_server(url).VolumeMarkWritable(
                                 volume_server_pb2.VolumeMarkWritableRequest(
                                     volume_id=vid))
+                        # lint: swallow-ok(node down: nothing left to unfreeze)
                         except Exception:
-                            pass  # node down: nothing left to unfreeze
+                            pass
                 continue
             for vid in group:
                 out.write(f"volume {vid}: generated 14 shards "

@@ -282,6 +282,7 @@ def fs_configure(env, argv, out):
     try:
         status, body, _ = http_client.get(env.filer_url, FILER_CONF_PATH)
         conf = FilerConf.from_bytes(body) if status == 200 else FilerConf()
+    # lint: swallow-ok(absent/unreadable conf means the empty default)
     except Exception:
         conf = FilerConf()
 

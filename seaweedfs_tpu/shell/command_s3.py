@@ -74,6 +74,7 @@ def _read_iam(env: CommandEnv) -> dict:
     from seaweedfs_tpu.filer import http_client
     try:
         status, body, _ = http_client.get(env.filer_url, IAM_PATH)
+    # lint: swallow-ok(absent/unreadable iam config means no identities)
     except Exception:
         return {"identities": []}
     if status != 200 or not body:

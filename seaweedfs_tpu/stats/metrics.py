@@ -285,8 +285,10 @@ RequestCounter = REGISTRY.counter(
     "SeaweedFS_request_total", "number of requests", ("type", "name"))
 RequestHistogram = REGISTRY.histogram(
     "SeaweedFS_request_seconds", "request latency", ("type", "name"))
+# lint: metric-ok(reference family name predates the lowercase rule; renaming breaks dashboards)
 VolumeServerVolumeCounter = REGISTRY.gauge(
     "SeaweedFS_volumeServer_volumes", "volume count", ("collection", "type"))
+# lint: metric-ok(reference family name predates the lowercase rule; renaming breaks dashboards)
 VolumeServerDiskSizeGauge = REGISTRY.gauge(
     "SeaweedFS_volumeServer_total_disk_size", "disk size", ("collection", "type"))
 MetricsPushErrorCounter = REGISTRY.counter(
@@ -424,6 +426,29 @@ HttpPoolStaleRetryCounter = REGISTRY.counter(
 HttpPoolReapedCounter = REGISTRY.counter(
     "SeaweedFS_http_pool_reaped_total",
     "pooled connections closed for exceeding the idle age cap")
+
+# Swallowed-error ledger (the `swallow` house rule, ISSUE 8): broad
+# except handlers that deliberately absorb an error must leave a trace
+# — either a log line or this counter. `site` is a short static label
+# naming the handler ("masterclient.follow", "s3.iam_watch"), never a
+# path or fid.
+SwallowedErrorsCounter = REGISTRY.counter(
+    "SeaweedFS_swallowed_errors_total",
+    "errors absorbed by intentional broad except handlers", ("site",))
+
+# Runtime concurrency sanitizer (util/sanitizer.py, SEAWEED_SANITIZE):
+# `kind` is "cycle" (lock-order cycle = potential deadlock) or "hold"
+# (lock held past the watchdog threshold).
+SanitizerFindingsCounter = REGISTRY.counter(
+    "SeaweedFS_sanitizer_findings_total",
+    "concurrency sanitizer findings", ("kind",))
+
+
+def swallowed(site: str) -> None:
+    """Bump the swallowed-error counter for a named handler site —
+    the one-liner the static analyzer (`swallow` check) recognizes as
+    error accounting."""
+    SwallowedErrorsCounter.labels(site).inc()
 
 # Resilience families (seaweedfs_tpu/resilience/): the failure-handling
 # substrate's ledger — injected faults, breaker state, hedging volume,
@@ -812,6 +837,7 @@ def start_metrics_server(port: int, registry: Registry = REGISTRY,
             pass
 
     srv = TrackingHTTPServer((ip, port), Handler)
+    # lint: thread-ok(metrics listener daemon; no request context)
     threading.Thread(target=srv.serve_forever, daemon=True,
                      name=f"metrics-{port}").start()
     return srv
@@ -852,6 +878,7 @@ def loop_pushing_metric(name: str, instance: str, addr: str,
             else:
                 time.sleep(interval_seconds)
 
+    # lint: thread-ok(push-gateway daemon; no request context)
     t = threading.Thread(target=loop, daemon=True, name="metrics-push")
     t.start()
     return t

@@ -295,7 +295,7 @@ register_backend_factory("memory", _memory_factory)
 
 # the "s3" scheme registers itself on import (kept in its own module so
 # this one stays dependency-light)
-from seaweedfs_tpu.storage import backend_s3  # noqa: E402,F401
+from seaweedfs_tpu.storage import backend_s3  # noqa: E402,F401  # lint: dead-ok(side-effect import registers the s3 backend)
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,9 @@ import threading
 from typing import Dict, Optional
 
 from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.util import wlog
+
+log = wlog.logger("storage")
 
 _DAT_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.(?:dat|tier)$")
 _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ec(?P<shard>\d\d)$")
@@ -56,7 +59,9 @@ class DiskLocation:
                         self.volumes[vid] = Volume(
                             self.directory, col, vid, create_if_missing=False,
                             needle_map_kind=self.needle_map_kind)
-                    except Exception:
+                    except Exception as e:
+                        log.warning("volume %d in %s unloadable, "
+                                    "skipped: %s", vid, self.directory, e)
                         continue
             self._load_ec_shards()
 

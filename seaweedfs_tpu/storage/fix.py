@@ -46,8 +46,9 @@ def scan_dat(dat_path: str) -> Iterator[Tuple[int, "Needle"]]:
                 break
             try:
                 n = Needle.from_bytes(blob, version, check_crc=False)
+            # lint: swallow-ok(torn/corrupt tail terminates the scan by design)
             except Exception:
-                break  # torn/corrupt tail: stop like the reference
+                break  # stop like the reference
             yield offset, n
             offset += length
 

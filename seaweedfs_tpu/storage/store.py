@@ -7,7 +7,7 @@ Reference: weed/storage/store.go (struct :32-48, read/write/delete
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from seaweedfs_tpu.storage.backend import read_tier_info
 from seaweedfs_tpu.storage.disk_location import DiskLocation

@@ -35,7 +35,7 @@ from seaweedfs_tpu.storage.needle import (
     Needle, NeedleError, CookieMismatch, actual_size, VERSION3,
     verify_needle_integrity,
 )
-from seaweedfs_tpu.storage.needle_map import NeedleMap, make_needle_map
+from seaweedfs_tpu.storage.needle_map import make_needle_map
 from seaweedfs_tpu.storage.superblock import SuperBlock, ReplicaPlacement, TTL
 from seaweedfs_tpu.storage import idx as idx_codec
 
@@ -118,6 +118,7 @@ class _GroupCommitWriter:
         self._queue: collections.deque[_WriteRequest] = collections.deque()
         self._cond = threading.Condition()
         self._stopped = False
+        # lint: gate-ok(constructed lazily by _get_writer on the first async write) # lint: thread-ok(group-commit writer; requests rendezvous on futures at the submit seam)
         self._thread = threading.Thread(
             target=self._run, name=f"vol-{volume.id}-writer", daemon=True)
         self._thread.start()

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Tuple
 
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage import idx as idx_codec

@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from seaweedfs_tpu.ec.shard_bits import ShardBits, DATA_SHARDS
+from seaweedfs_tpu.ec.shard_bits import ShardBits
 from seaweedfs_tpu.storage.superblock import ReplicaPlacement
 from seaweedfs_tpu.topology.node import DataCenter, DataNode, VolumeInfo
 from seaweedfs_tpu.topology.sequence import MemorySequencer
@@ -220,6 +220,7 @@ class Topology:
         for cb in list(self.listeners):
             try:
                 cb()
+            # lint: swallow-ok(evicting the failing listener IS the handling)
             except Exception:
                 self.listeners.remove(cb)
 

@@ -8,7 +8,7 @@ else decompresses on the fly.
 from __future__ import annotations
 
 import gzip
-from typing import Optional, Tuple
+from typing import Tuple
 
 try:
     import zstandard as _zstd

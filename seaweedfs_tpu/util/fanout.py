@@ -103,6 +103,7 @@ class FanOutPool:
         with self._lock:
             stopping = self._stopping
             if not stopping:
+                # lint: block-ok(SimpleQueue.put never blocks; the lock orders enqueue against stop's sentinels)
                 self._q.put((fut, ctx, fn, args))
                 if len(self._threads) < self.size:
                     t = threading.Thread(

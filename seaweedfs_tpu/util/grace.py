@@ -54,6 +54,7 @@ def run_hooks() -> None:
     for fn in reversed(hooks):
         try:
             fn()
+        # lint: swallow-ok(shutdown hooks are best-effort by contract)
         except Exception:
             pass
 

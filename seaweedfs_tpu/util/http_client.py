@@ -162,12 +162,14 @@ def _reap(expired) -> None:
 
 
 def close_all() -> None:
-    """Drop every pooled connection (tests / topology changes)."""
+    """Drop every pooled connection (tests / topology changes).
+    Sockets are closed OUTSIDE the pool lock — close() can block on a
+    lingering send, and the pool lock sits on the request hot path."""
     with _pool_lock:
-        for conns in _pool.values():
-            for c in conns:
-                c.close()
+        doomed = [c for conns in _pool.values() for c in conns]
         _pool.clear()
+    for c in doomed:
+        c.close()
 
 
 class Response:

@@ -62,13 +62,24 @@ class LogBuffer:
         self._prev: List[List[LogEntry]] = []   # flushed, still in memory
         self._last_ts = 0
         self._stopping = False
-        self._flusher = threading.Thread(
-            target=self._flush_loop, name="log-buffer-flush", daemon=True)
-        self._flusher.start()
+        # flusher spawns lazily on the first add(): a process that
+        # never appends a meta event never grows this thread (the
+        # zero-threads-until-first-use house rule, `gate` check)
+        self._flusher: Optional[threading.Thread] = None
+
+    def _ensure_flusher(self) -> None:
+        # caller holds self._lock
+        if self._flusher is None and not self._stopping:
+            # lint: thread-ok(periodic flush daemon owns no request context)
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="log-buffer-flush",
+                daemon=True)
+            self._flusher.start()
 
     def add(self, data: bytes, key_hash: int = 0,
             ts_ns: Optional[int] = None) -> int:
         with self._lock:
+            self._ensure_flusher()
             ts = ts_ns if ts_ns is not None else time.time_ns()
             if ts <= self._last_ts:      # strictly monotonic, like the ref
                 ts = self._last_ts + 1

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import random
 import threading
-import time
 from typing import List, Optional
 
 import grpc
@@ -62,6 +61,7 @@ class MasterClient:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "MasterClient":
+        # lint: thread-ok(keep-connected daemon; reconnects use their own jittered backoff)
         self._thread = threading.Thread(
             target=self._keep_connected_loop,
             name=f"masterclient-{self.client_name}", daemon=True)
@@ -140,7 +140,8 @@ class MasterClient:
                 self._apply(loc)
                 self._ready.set()
         except Exception:  # noqa: BLE001 - see docstring
-            pass
+            from seaweedfs_tpu.stats import metrics
+            metrics.swallowed("masterclient.follow")
         # a stream that BROKE after establishing is not a dead master;
         # a dial that never produced a message — whether it raised or
         # closed cleanly empty — is, and MUST be recorded: breaker

