@@ -542,6 +542,37 @@ def degraded_read_sweep(batches=(1, 8, 64)) -> dict:
             "sweep": sweep}
 
 
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_server(*args):
+    """One real `python -m seaweedfs_tpu <role> ...` subprocess (the
+    bench_profile.py pattern, shared by the ingest and lifecycle
+    sweeps — in-process servers would share the client's GIL)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        cwd=REPO_ROOT, env=env)
+
+
+def _wait_http(url, timeout=60.0):
+    import urllib.request
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError(f"server at {url} never came up")
+
+
 def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
                           replications=("000", "010")) -> dict:
     """--ingest mode: filer multi-chunk upload throughput.
@@ -566,10 +597,8 @@ def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
     timings on shared VMs swing ±50%), plus master assign round trips
     per body on each path.
     """
-    import socket
     import subprocess
     import tempfile
-    import urllib.request
 
     from seaweedfs_tpu.operation.assign_lease import LeaseCache
     from seaweedfs_tpu.server.filer import FilerServer
@@ -578,28 +607,7 @@ def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
     repeats = int(os.environ.get("BENCH_INGEST_REPEATS", "3"))
     parallelism = int(os.environ.get("BENCH_INGEST_PARALLELISM", "8"))
     lease_count = int(os.environ.get("BENCH_INGEST_LEASES", "16"))
-
-    def free_port() -> int:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
-
-    def spawn(*args):
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        return subprocess.Popen(
-            [sys.executable, "-m", "seaweedfs_tpu", *args],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            cwd=REPO_ROOT, env=env)
-
-    def wait_http(url, timeout=60.0):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            try:
-                with urllib.request.urlopen(url, timeout=2):
-                    return
-            except OSError:
-                time.sleep(0.2)
-        raise RuntimeError(f"server at {url} never came up")
+    free_port, spawn, wait_http = _free_port, _spawn_server, _wait_http
 
     rng = np.random.default_rng(29)
     sweep = []
@@ -901,6 +909,206 @@ def chaos_sweep() -> dict:
     return out
 
 
+def lifecycle_sweep() -> dict:
+    """--lifecycle mode (ISSUE 9): a synthetic diurnal workload against
+    a REAL 3-server subprocess cluster with the policy engine on.
+
+    Shape: two volumes — HOT takes a steady read stream throughout;
+    COLD is written once and then left idle ("night"). The sweep
+    asserts the acceptance contract end to end: the idle volume is
+    EC-encoded by the policy loop with no operator action, sustained
+    reads ("morning") bring it back to a replicated volume, reads are
+    byte-identical across both transitions, and the hot volume's read
+    p99 while transitions run (under the byte-budget throttle) stays
+    within a generous factor of its pre-transition p99.
+    """
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    pulse = float(os.environ.get("BENCH_LIFECYCLE_PULSE", "0.3"))
+    heat_window = float(os.environ.get("BENCH_LIFECYCLE_WINDOW", "2.0"))
+    hot_dwell = float(os.environ.get("BENCH_LIFECYCLE_DWELL", "3.0"))
+    n_keys = int(os.environ.get("BENCH_LIFECYCLE_KEYS", "16"))
+    blob_kb = int(os.environ.get("BENCH_LIFECYCLE_BLOB_KB", "64"))
+    free_port, spawn, wait_http = _free_port, _spawn_server, _wait_http
+
+    def http_json(url, method="GET", timeout=10.0):
+        req = urllib.request.Request(f"http://{url}", method=method)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def normal_and_ec_vids(master_url):
+        topo = http_json(f"{master_url}/dir/status")["Topology"]
+        normal, ec = set(), set()
+        for dc in topo["data_centers"]:
+            for rack in dc["racks"]:
+                for node in rack["nodes"]:
+                    normal.update(v["id"] for v in node["volumes"])
+                    ec.update(e["id"] for e in node["ec_shards"])
+        return normal, ec
+
+    def pct(ordered, q):
+        return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+    cookie = 0x11CEC1E5
+    blob = os.urandom(blob_kb << 10)
+
+    def fid(vid, key):
+        return f"{vid},{key:x}{cookie:08x}"
+
+    def read_one(master_url, vid, key, timeout=5.0):
+        lk = http_json(f"{master_url}/dir/lookup?volumeId={vid}")
+        url = lk["locations"][0]["url"]
+        with urllib.request.urlopen(
+                f"http://{url}/{fid(vid, key)}", timeout=timeout) as r:
+            return r.read()
+
+    procs = []
+    out = {"metric": "lifecycle_diurnal", "unit": "ratio",
+           "heat_window_s": heat_window, "hot_dwell_s": hot_dwell}
+    with tempfile.TemporaryDirectory() as d:
+        mport = free_port()
+        master_url = f"127.0.0.1:{mport}"
+        try:
+            procs.append(spawn(
+                "master", "-port", str(mport),
+                "-mdir", os.path.join(d, "m"),
+                "-volumeSizeLimitMB", "64",
+                "-pulseSeconds", str(pulse),
+                "-lifecycle",
+                "-lifecycle.intervalSeconds", "0.5",
+                "-lifecycle.coolThreshold", "0.5",
+                "-lifecycle.warmThreshold", "5",
+                "-lifecycle.hotDwellSeconds", str(hot_dwell),
+                "-lifecycle.warmDwellSeconds", "1.0",
+                "-lifecycle.coldDwellSeconds", "1.0",
+                "-lifecycle.maxInflight", "4",
+                "-lifecycle.throttleMBps", "64"))
+            wait_http(f"http://{master_url}/cluster/status")
+            for i in range(3):
+                vport = free_port()
+                procs.append(spawn(
+                    "volume", "-port", str(vport),
+                    "-dir", os.path.join(d, f"v{i}"), "-max", "50",
+                    "-mserver", master_url,
+                    "-pulseSeconds", str(pulse),
+                    "-heat.track",
+                    "-heat.windowSeconds", str(heat_window)))
+                wait_http(f"http://127.0.0.1:{vport}/status")
+            time.sleep(pulse * 3)   # heartbeats register the nodes
+
+            grown = http_json(
+                f"{master_url}/vol/grow?count=2&replication=000",
+                method="POST")["volumeIds"]
+            hot_vid, cold_vid = grown[0], grown[1]
+            for vid in (hot_vid, cold_vid):
+                lk = http_json(f"{master_url}/dir/lookup?volumeId={vid}")
+                url = lk["locations"][0]["url"]
+                for k in range(1, n_keys + 1):
+                    req = urllib.request.Request(
+                        f"http://{url}/{fid(vid, k)}", data=blob,
+                        method="POST")
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()
+
+            # "day": steady hot reads, pre-transition p99 baseline
+            def hot_read_window(seconds):
+                lats = []
+                stop = time.monotonic() + seconds
+                k = 0
+                while time.monotonic() < stop:
+                    k = k % n_keys + 1
+                    t0 = time.perf_counter()
+                    got = read_one(master_url, hot_vid, k)
+                    lats.append(time.perf_counter() - t0)
+                    assert got == blob, "hot read bytes differ"
+                return sorted(lats)
+
+            base = hot_read_window(3.0)
+            out["hot_p99_before_ms"] = round(pct(base, 0.99) * 1000, 2)
+
+            # "night": cold volume idles past dwell; keep the hot one
+            # hot while the engine encodes — p99 measured DURING
+            encode_t0 = time.monotonic()
+            during = []
+            encoded = False
+            while time.monotonic() - encode_t0 < 90:
+                during.extend(hot_read_window(1.0))
+                normal, ec = normal_and_ec_vids(master_url)
+                if cold_vid in ec and cold_vid not in normal:
+                    encoded = True
+                    break
+            out["encode_s"] = round(time.monotonic() - encode_t0, 1)
+            during.sort()
+            out["hot_p99_during_ms"] = round(pct(during, 0.99) * 1000, 2)
+            if not encoded:
+                raise SystemExit(
+                    "cold volume was never EC-encoded by the policy "
+                    "loop")
+            # byte-identity on the now-WARM volume
+            assert read_one(master_url, cold_vid, 1) == blob, \
+                "post-encode read bytes differ"
+
+            # "morning": sustained reads re-heat the cold volume until
+            # the engine decodes it back to a replicated volume
+            decode_t0 = time.monotonic()
+            decoded = False
+            k = 0
+            while time.monotonic() - decode_t0 < 90:
+                for _ in range(8):
+                    k = k % n_keys + 1
+                    try:
+                        got = read_one(master_url, cold_vid, k,
+                                       timeout=3.0)
+                        assert got == blob, "re-heat read bytes differ"
+                    except OSError:
+                        pass   # mid-decode blip: shards unmounting
+                normal, ec = normal_and_ec_vids(master_url)
+                if cold_vid in normal and cold_vid not in ec:
+                    decoded = True
+                    break
+                time.sleep(0.2)
+            out["decode_s"] = round(time.monotonic() - decode_t0, 1)
+            if not decoded:
+                raise SystemExit(
+                    "re-heated volume never returned to replicated "
+                    "form")
+            for k in range(1, n_keys + 1):
+                assert read_one(master_url, cold_vid, k) == blob, \
+                    "post-decode read bytes differ"
+
+            st = http_json(f"{master_url}/cluster/lifecycle")
+            out["transitions_ok"] = st.get("transitions_ok", 0)
+            out["passes"] = st.get("passes", 0)
+            out["decisions"] = [
+                {k: v for k, v in dd.items() if k != "ts"}
+                for dd in st.get("decisions", [])][-6:]
+
+            ratio = out["hot_p99_during_ms"] / \
+                max(out["hot_p99_before_ms"], 0.01)
+            out["value"] = round(ratio, 3)
+            # generous VM-noise gate: transitions must not blow the hot
+            # plane's tail out by an order of magnitude
+            out["p99_gate_ok"] = \
+                out["hot_p99_during_ms"] <= max(
+                    5 * out["hot_p99_before_ms"], 100.0)
+            if not out["p99_gate_ok"]:
+                raise SystemExit(
+                    f"hot-volume p99 regressed while transitions ran: "
+                    f"{out['hot_p99_before_ms']}ms -> "
+                    f"{out['hot_p99_during_ms']}ms")
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    return out
+
+
 def lint_bench() -> dict:
     """--lint mode (ISSUE 8): time the full-tree house-rules analyzer
     pass. The contract is < 30 s on the 2-core CI VM — cheap enough
@@ -937,6 +1145,13 @@ def lint_bench() -> dict:
 
 
 def main() -> None:
+    if "--lifecycle" in sys.argv:
+        line = lifecycle_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_LIFECYCLE.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
     if "--lint" in sys.argv:
         line = lint_bench()
         with open(os.path.join(REPO_ROOT, "BENCH_LINT.json"),

@@ -87,11 +87,89 @@ def _master_parser() -> argparse.ArgumentParser:
     p.add_argument("-scrubMBps", dest="scrub_throttle_mbps", type=float,
                    default=0.0,
                    help="IO budget handed to each scheduled scrub")
+    _add_lifecycle_args(p)
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_trace_args(p)
     return p
+
+
+def _add_lifecycle_args(p: argparse.ArgumentParser) -> None:
+    """Master-only -lifecycle.* flags (seaweedfs_tpu/lifecycle/): the
+    heat-driven policy engine that EC-encodes cold volumes, un-cools
+    re-heated ones, and tier-offloads frozen ones. Off by default —
+    a master without -lifecycle constructs no engine at all."""
+    p.add_argument("-lifecycle", dest="lifecycle", action="store_true",
+                   help="enable the heat-driven lifecycle policy "
+                        "engine (leader-only; needs volume servers "
+                        "running -heat.track)")
+    p.add_argument("-lifecycle.dryRun", dest="lifecycle_dry_run",
+                   action="store_true",
+                   help="log and ledger every decision WITHOUT acting "
+                        "— run this first on any real cluster")
+    p.add_argument("-lifecycle.intervalSeconds",
+                   dest="lifecycle_interval_s", type=float, default=60.0,
+                   help="policy pass cadence")
+    p.add_argument("-lifecycle.coolThreshold",
+                   dest="lifecycle_cool_threshold", type=float,
+                   default=0.0,
+                   help="window reads at or below this (AND a matching "
+                        "EWMA) make a volume a cool-down candidate")
+    p.add_argument("-lifecycle.warmThreshold",
+                   dest="lifecycle_warm_threshold", type=float,
+                   default=50.0,
+                   help="window reads at or above this heat a volume "
+                        "back up (must exceed coolThreshold — the gap "
+                        "is the hysteresis band)")
+    p.add_argument("-lifecycle.hotDwellSeconds",
+                   dest="lifecycle_hot_dwell_s", type=float,
+                   default=600.0,
+                   help="minimum residence in HOT before an encode "
+                        "(also the write-quiet guard)")
+    p.add_argument("-lifecycle.warmDwellSeconds",
+                   dest="lifecycle_warm_dwell_s", type=float,
+                   default=600.0,
+                   help="minimum residence in WARM before any move")
+    p.add_argument("-lifecycle.coldDwellSeconds",
+                   dest="lifecycle_cold_dwell_s", type=float,
+                   default=3600.0,
+                   help="minimum residence in COLD before a download")
+    p.add_argument("-lifecycle.freezeSeconds",
+                   dest="lifecycle_freeze_s", type=float, default=0.0,
+                   help="WARM volumes idle this long offload to the "
+                        "cold backend (0 = never freeze)")
+    p.add_argument("-lifecycle.coldBackend",
+                   dest="lifecycle_cold_backend", default="",
+                   help="storage backend for the COLD tier, e.g. "
+                        "s3.default (empty = COLD disabled)")
+    p.add_argument("-lifecycle.maxInflight",
+                   dest="lifecycle_max_inflight", type=int, default=2,
+                   help="cluster-wide cap on transitions in motion "
+                        "per pass")
+    p.add_argument("-lifecycle.throttleMBps",
+                   dest="lifecycle_throttle_mbps", type=float,
+                   default=0.0,
+                   help="byte budget pacing transition admission "
+                        "(0 = unthrottled)")
+
+
+def _lifecycle_config(opts):
+    if not getattr(opts, "lifecycle", False):
+        return None
+    from seaweedfs_tpu.lifecycle import LifecycleConfig
+    return LifecycleConfig(
+        dry_run=opts.lifecycle_dry_run,
+        interval_s=opts.lifecycle_interval_s,
+        cool_threshold=opts.lifecycle_cool_threshold,
+        warm_threshold=opts.lifecycle_warm_threshold,
+        hot_dwell_s=opts.lifecycle_hot_dwell_s,
+        warm_dwell_s=opts.lifecycle_warm_dwell_s,
+        cold_dwell_s=opts.lifecycle_cold_dwell_s,
+        freeze_s=opts.lifecycle_freeze_s,
+        cold_backend=opts.lifecycle_cold_backend,
+        max_inflight=opts.lifecycle_max_inflight,
+        throttle_mbps=opts.lifecycle_throttle_mbps)
 
 
 def _build_master(opts):
@@ -119,6 +197,7 @@ def _build_master(opts):
         maintenance_interval_s=float(sleep_minutes) * 60,
         scrub_interval_s=opts.scrub_interval_s,
         scrub_throttle_mbps=opts.scrub_throttle_mbps,
+        lifecycle=_lifecycle_config(opts),
         sequencer_type=conf.get_string("master.sequencer.type", "memory"),
         sequencer_node_id=conf.get("master.sequencer.node_id"),
         sequencer_etcd_urls=conf.get_string(

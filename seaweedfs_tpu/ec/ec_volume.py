@@ -58,25 +58,85 @@ def _get_recover_pool() -> ThreadPoolExecutor:
 
 
 class EcVolumeShard:
-    """One mounted .ecNN file (reference ec_shard.go:16-95)."""
+    """One mounted .ecNN shard (reference ec_shard.go:16-95).
 
-    def __init__(self, directory: str, collection: str, vid: int, shard_id: int):
+    A shard is either LOCAL (an open file) or REMOTE (the bytes live
+    in a cloud backend, recorded by the <base>.ectier sidecar —
+    storage/volume_tier.move_ec_shards_to_remote): reads route through
+    ranged backend GETs, the shard stays mounted, and the heartbeat
+    keeps advertising it, so the COLD tier is transparent to every
+    consumer of read_at (needle reads, scrub verify, remote shard
+    serving, RS reconstruction rows)."""
+
+    def __init__(self, directory: str, collection: str, vid: int,
+                 shard_id: int, remote=None):
         self.collection = collection
         self.volume_id = vid
         self.shard_id = shard_id
         name = f"{collection}_{vid}" if collection else str(vid)
         self.path = shard_file_name(os.path.join(directory, name), shard_id)
-        self._f = open(self.path, "rb")
-        self.size = os.path.getsize(self.path)
         self._lock = threading.Lock()
+        self._remote = None          # (BackendStorage, key) when tiered
+        if remote is not None:
+            storage, key, size = remote
+            self._remote = (storage, key)
+            self._f = None
+            self.size = size
+        else:
+            self._f = open(self.path, "rb")
+            self.size = os.path.getsize(self.path)
+
+    @property
+    def is_remote(self) -> bool:
+        return self._remote is not None
 
     def read_at(self, offset: int, length: int) -> bytes:
+        remote = self._remote
+        if remote is not None:
+            storage, key = remote
+            try:
+                return storage.read_range(key, offset, length)
+            except Exception:
+                # the download leg may have swapped this shard local
+                # (and deleted the remote object) between our snapshot
+                # and the ranged GET: serve from the file if so, else
+                # surface the backend error
+                with self._lock:
+                    if self._f is None:
+                        raise
+                    self._f.seek(offset)
+                    return self._f.read(length)
         with self._lock:
+            if self._f is None:      # swapped remote mid-read
+                storage, key = self._remote
+                return storage.read_range(key, offset, length)
             self._f.seek(offset)
             return self._f.read(length)
 
+    def swap_to_remote(self, storage, key: str, size: int) -> None:
+        """Serve from the backend from now on (the tier-upload handle
+        swap; the caller deletes the local file afterwards)."""
+        with self._lock:
+            old, self._f = self._f, None
+            self._remote = (storage, key)
+            self.size = size
+        if old is not None:
+            old.close()
+
+    def swap_to_local(self) -> None:
+        """Back to the local file (tier download re-materialized it)."""
+        f = open(self.path, "rb")
+        size = os.path.getsize(self.path)
+        with self._lock:
+            self._f = f
+            self._remote = None
+            self.size = size
+
     def close(self) -> None:
-        self._f.close()
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
     def destroy(self) -> None:
         self.close()
@@ -153,9 +213,24 @@ class EcVolume:
             if shard_id in self.shards:
                 return self.shards[shard_id]
             s = EcVolumeShard(self.directory, self.collection, self.volume_id,
-                              shard_id)
+                              shard_id, remote=self._remote_info(shard_id))
             self.shards[shard_id] = s
             return s
+
+    def _remote_info(self, shard_id: int):
+        """(storage, key, size) for a shard this server tiered to a
+        cloud backend (<base>.ectier sidecar), else None — so a
+        restart remounts COLD shards without their local files."""
+        if os.path.exists(shard_file_name(self.base_name, shard_id)):
+            return None             # local file wins
+        from seaweedfs_tpu.storage import backend as bk
+        info = bk.read_ec_tier_info(self.base_name)
+        if info is None:
+            return None
+        rec = info["shards"].get(shard_id)
+        if rec is None:
+            return None
+        return bk.get_backend(info["backend"]), rec["key"], rec["size"]
 
     def unmount_shard(self, shard_id: int) -> bool:
         with self._lock:

@@ -94,6 +94,7 @@ class MasterServer:
                  maintenance_interval_s: float = 17 * 60,
                  scrub_interval_s: float = 0.0,
                  scrub_throttle_mbps: float = 0.0,
+                 lifecycle: Optional[object] = None,
                  sequencer_type: str = "memory",
                  sequencer_node_id: Optional[int] = None,
                  sequencer_etcd_urls: str = "127.0.0.1:2379"):
@@ -168,6 +169,14 @@ class MasterServer:
         self.scrub_throttle_mbps = scrub_throttle_mbps
         self._scrub_thread: Optional[threading.Thread] = None
         self._scrub_wake = threading.Event()
+        # heat-driven lifecycle policy engine (-lifecycle): absent —
+        # not merely idle — unless configured, so a default master
+        # pays nothing (no engine object, no thread, heartbeats
+        # byte-identical; test_lifecycle_disabled_overhead)
+        self.lifecycle = None
+        if lifecycle is not None:
+            from seaweedfs_tpu.lifecycle import LifecycleEngine
+            self.lifecycle = LifecycleEngine(self, lifecycle)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -205,6 +214,8 @@ class MasterServer:
                 target=self._scrub_loop, name="master-scrub",
                 daemon=True)
             self._scrub_thread.start()
+        if self.lifecycle is not None:
+            self.lifecycle.start()
         log.info("master %s started (grpc :%d)", self.url,
                  self.port + rpc.GRPC_PORT_OFFSET)
 
@@ -213,6 +224,8 @@ class MasterServer:
         self._stopping = True
         self._maint_wake.set()
         self._scrub_wake.set()
+        if self.lifecycle is not None:
+            self.lifecycle.stop()
         self.raft.stop()
         self._save_sequence()
         if self._http_server:
@@ -824,6 +837,70 @@ class MasterServer:
                 "Leader": self.raft.leader() or "",
                 "Peers": self.raft.peers}
 
+    def http_status(self) -> dict:
+        """GET /status: the master's role block (the volume server's
+        /status twin) — Lifecycle state machine + live cluster heat."""
+        return {
+            "Version": "seaweedfs-tpu",
+            "IsLeader": self.raft.is_leader,
+            "Lifecycle": self.lifecycle.status()
+            if self.lifecycle is not None else {"enabled": False},
+            "Heat": {str(vid): rec for vid, rec in
+                     sorted(self.topo.cluster_heat().items())},
+        }
+
+    def http_cluster_heat(self) -> dict:
+        """GET /cluster/heat: the heartbeat-fed cluster heat map, with
+        each vid's observed tier — what `cluster.heat` renders."""
+        heat = self.topo.cluster_heat()
+        ec_vids = set(self.topo.ec_locations)
+        vol_vids = {vid for n in self.topo.nodes() for vid in n.volumes}
+        out = {}
+        for vid in sorted(vol_vids | ec_vids | set(heat)):
+            rec = dict(heat.get(vid, {"reads_window": 0.0, "ewma": 0.0,
+                                      "servers": []}))
+            rec["tier"] = "warm" if vid in ec_vids and vid not in vol_vids \
+                else "hot"
+            if self.lifecycle is not None:
+                st = self.lifecycle.states.get(vid)
+                if st is not None:
+                    rec["state"] = st.state
+            out[str(vid)] = rec
+        return {"volumes": out}
+
+    def http_lifecycle(self, params: dict, method: str = "GET") -> dict:
+        """GET/POST /cluster/lifecycle: status (default), and the
+        volume.lifecycle verbs — pause / resume / force."""
+        if self.lifecycle is None:
+            return {"enabled": False,
+                    "error": "lifecycle disabled (start the master "
+                             "with -lifecycle)"}
+        action = params.get("action", [""])[0]
+        if not action or action == "status":
+            return self.lifecycle.status()
+        if method != "POST":
+            return {"error": f"action {action!r} requires POST"}
+        if action == "pause":
+            self.lifecycle.pause()
+            return {"paused": True}
+        if action == "resume":
+            self.lifecycle.resume()
+            return {"paused": False}
+        if action == "run":
+            self.lifecycle.run_pass_now()
+            return {"triggered": True}
+        if action == "force":
+            try:
+                vid = int(params.get("volumeId", ["0"])[0])
+                kind = self.lifecycle.force(
+                    vid, params.get("target", [""])[0])
+            except ValueError as e:
+                return {"error": str(e)}
+            self.lifecycle.run_pass_now()
+            return {"queued": kind, "volumeId": vid}
+        return {"error": f"unknown action {action!r} (status | pause | "
+                         "resume | run | force)"}
+
 
 def _make_http_handler(ms: MasterServer):
     class Handler(FastHandler):
@@ -885,6 +962,11 @@ def _make_http_handler(ms: MasterServer):
                 self._json(cluster_trace.debug_payload(
                     self.path, "master", ms.url))
                 return
+            if upath == "/status":
+                # this master's own role block (the volume server's
+                # /status twin) — never proxied
+                self._json(ms.http_status())
+                return
             if upath != "/cluster/status" and self._proxy_to_leader():
                 return
             if upath == "/dir/assign":
@@ -902,6 +984,10 @@ def _make_http_handler(ms: MasterServer):
                 self._json({"compacted": vids})
             elif upath == "/cluster/status":
                 self._json(ms.http_cluster_status())
+            elif upath == "/cluster/heat":
+                self._json(ms.http_cluster_heat())
+            elif upath == "/cluster/lifecycle":
+                self._json(ms.http_lifecycle(params, self.command))
             elif upath in ("/", "/ui"):
                 self._html(_master_ui(ms))
             else:

@@ -227,6 +227,14 @@ class VolumeServer:
     def _heartbeat_gen(self):
         while not self._stopping:
             hb = self.store.collect_heartbeat()
+            if self.heat is not None:
+                # heat summary rides the heartbeat: the master's
+                # topology aggregates every server's window reads +
+                # decayed EWMA into the cluster heat map the lifecycle
+                # policy engine decides from. Absent (not empty) when
+                # -heat.track is off, so the disabled wire format is
+                # byte-identical to pre-lifecycle heartbeats.
+                hb["volume_heats"] = self.heat.summary()
             yield convert.heartbeat_to_pb(hb, self.data_center, self.rack)
             self._hb_wake.wait(timeout=self.pulse_seconds)
             self._hb_wake.clear()
@@ -300,6 +308,7 @@ class VolumeServer:
 
     def VolumeDelete(self, request, context):
         self.store.delete_volume(request.volume_id)
+        self._forget_heat(request.volume_id)
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeDeleteResponse()
 
@@ -350,6 +359,7 @@ class VolumeServer:
             if v is not None:
                 v.close()
                 loc.volumes.pop(vid, None)
+        self._forget_heat(vid)
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeUnmountResponse()
 
@@ -358,12 +368,21 @@ class VolumeServer:
             for vid, v in list(loc.volumes.items()):
                 if v.collection == request.collection:
                     loc.delete_volume(vid)
+                    self._forget_heat(vid)
             for vid, ecv in list(loc.ec_volumes.items()):
                 if ecv.collection == request.collection:
                     ecv.destroy()
                     loc.ec_volumes.pop(vid, None)
+                    self._forget_heat(vid)
         self.trigger_heartbeat()
         return volume_server_pb2.DeleteCollectionResponse()
+
+    def _forget_heat(self, vid: int) -> None:
+        """Heat hygiene on volume departure/conversion: without this a
+        dead vid's SeaweedFS_volume_heat{vid} child and counters
+        linger forever (unbounded label growth)."""
+        if self.heat is not None:
+            self.heat.forget(vid)
 
     def ReadVolumeFileStatus(self, request, context):
         v = self.store.find_volume(request.volume_id)
@@ -658,12 +677,28 @@ class VolumeServer:
     # -- gRPC: cloud tier ------------------------------------------------------
 
     def VolumeTierMoveDatToRemote(self, request, context):
-        """Upload a sealed volume's .dat to the named storage backend
-        (reference volume_grpc_tier_upload.go)."""
+        """Upload a sealed volume's bulk bytes to the named storage
+        backend (reference volume_grpc_tier_upload.go). A normal
+        volume moves its .dat; an erasure-coded vid moves this
+        server's .ecNN shard files instead (the lifecycle engine's
+        WARM -> COLD leg) — the .idx/.ecx index always stays local."""
         v = self.store.find_volume(request.volume_id)
         if v is None:
-            context.abort(grpc.StatusCode.NOT_FOUND,
-                          f"volume {request.volume_id} not found")
+            ecv = self.store.find_ec_volume(request.volume_id)
+            if ecv is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"volume {request.volume_id} not found")
+            try:
+                total = volume_tier.move_ec_shards_to_remote(
+                    ecv, request.destination_backend_name,
+                    keep_local=request.keep_local_dat_file,
+                    owner=self.url)
+            except (VolumeError, BackendError) as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              str(e))
+            yield volume_server_pb2.VolumeTierMoveDatToRemoteResponse(
+                processed=total, processed_percentage=100.0)
+            return
         total = max(v.content_size, 1)
         progress_state = {"sent": 0}
 
@@ -683,12 +718,24 @@ class VolumeServer:
             processed_percentage=100.0 * progress_state["sent"] / total)
 
     def VolumeTierMoveDatFromRemote(self, request, context):
-        """Download a tiered volume's .dat back to local disk
-        (reference volume_grpc_tier_download.go)."""
+        """Download a tiered volume's bulk bytes back to local disk
+        (reference volume_grpc_tier_download.go); EC vids restore this
+        server's shard files (the COLD -> WARM leg)."""
         v = self.store.find_volume(request.volume_id)
         if v is None:
-            context.abort(grpc.StatusCode.NOT_FOUND,
-                          f"volume {request.volume_id} not found")
+            ecv = self.store.find_ec_volume(request.volume_id)
+            if ecv is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"volume {request.volume_id} not found")
+            try:
+                total = volume_tier.move_ec_shards_from_remote(
+                    ecv, keep_remote=request.keep_remote_dat_file)
+            except (VolumeError, BackendError) as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              str(e))
+            yield volume_server_pb2.VolumeTierMoveDatFromRemoteResponse(
+                processed=total, processed_percentage=100.0)
+            return
         state = {"done": 0}
 
         def progress(nbytes):
@@ -720,6 +767,10 @@ class VolumeServer:
                     backend=request.encoder or self.ec_encoder)
         except NeedleError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        for vid in vids:
+            # tier conversion resets the vid's heat ledger: the EC era
+            # starts counting from zero (reads re-register on demand)
+            self._forget_heat(vid)
         return volume_server_pb2.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, request, context):
@@ -808,8 +859,10 @@ class VolumeServer:
         except EcShardNotFound as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         # the vid serves from a normal volume now: EC-era cache entries
-        # must not outlive the transition (writes can land again)
+        # must not outlive the transition (writes can land again), and
+        # the EC era's heat ledger resets with the tier
         self._invalidate_volume_cache(request.volume_id, "rebuild")
+        self._forget_heat(request.volume_id)
         self.trigger_heartbeat()
         return volume_server_pb2.VolumeEcShardsToVolumeResponse()
 

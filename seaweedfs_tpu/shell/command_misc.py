@@ -188,6 +188,35 @@ def cluster_requests(env: CommandEnv, argv: List[str], out) -> None:
             + "\n")
 
 
+@command("cluster.heat", "the live cluster heat map, per volume")
+def cluster_heat(env: CommandEnv, argv: List[str], out) -> None:
+    """Render the master's heartbeat-fed heat map (GET /cluster/heat):
+    per volume, cluster-summed window reads + decayed EWMA rate, the
+    servers reporting it, and the lifecycle state when the policy
+    engine runs. Empty unless volume servers run -heat.track."""
+    from seaweedfs_tpu.util import http_client
+    p = argparse.ArgumentParser(prog="cluster.heat")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="restrict to one volume id")
+    args = p.parse_args(argv)
+    resp = http_client.request(
+        "GET", f"{env.master_url}/cluster/heat", timeout=30)
+    vols = json.loads(resp.body).get("volumes", {})
+    if args.volumeId:
+        vols = {k: v for k, v in vols.items()
+                if k == str(args.volumeId)}
+    if not vols:
+        out.write("no heat reported (are volume servers running "
+                  "-heat.track?)\n")
+        return
+    for vid, rec in sorted(vols.items(), key=lambda kv: int(kv[0])):
+        state = rec.get("state", rec.get("tier", "?"))
+        out.write(
+            f"volume {vid}: reads/window:{rec.get('reads_window', 0):.0f} "
+            f"ewma:{rec.get('ewma', 0):.2f}/s state:{state} "
+            f"servers:{','.join(rec.get('servers', [])) or '-'}\n")
+
+
 @command("lock", "acquire the cluster admin lock")
 def lock(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()

@@ -767,11 +767,20 @@ def volume_unmount(env: CommandEnv, argv: List[str], out) -> None:
     out.write(f"volume {args.volumeId}: unmounted on {args.node}\n")
 
 
-@command("volume.tier.upload", "move a sealed volume's .dat to a storage "
-                               "backend")
+@command("volume.tier.upload", "move a sealed volume's .dat (or an EC "
+                               "volume's shards) to a storage backend")
 def volume_tier_upload(env: CommandEnv, argv: List[str], out) -> None:
     """Reference: weed/shell/command_volume_tier_upload.go — mark the
-    volume readonly, then VolumeTierMoveDatToRemote on each holder."""
+    volume readonly, then VolumeTierMoveDatToRemote on each holder.
+    For an erasure-coded vid the holders are its shard servers and
+    each moves its local .ecNN files (the lifecycle COLD leg).
+
+    Idempotent: a holder whose copy is already tiered is SKIPPED
+    instead of aborting the remaining-holder loop mid-way — a re-run
+    after a partial failure (or the lifecycle policy loop re-freezing
+    a volume it forgot across a master restart) finishes the stragglers
+    without erroring on the ones that made it."""
+    import grpc as _grpc
     p = argparse.ArgumentParser(prog="volume.tier.upload")
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-dest", required=True,
@@ -779,31 +788,131 @@ def volume_tier_upload(env: CommandEnv, argv: List[str], out) -> None:
     p.add_argument("-keepLocalDatFile", action="store_true")
     args = p.parse_args(argv)
     for url in env.lookup(args.volumeId):
-        env.volume_server(url).VolumeMarkReadonly(
-            volume_server_pb2.VolumeMarkReadonlyRequest(
-                volume_id=args.volumeId))
-        for resp in env.volume_server(url).VolumeTierMoveDatToRemote(
-                volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
-                    volume_id=args.volumeId,
-                    destination_backend_name=args.dest,
-                    keep_local_dat_file=args.keepLocalDatFile)):
-            out.write(f"volume {args.volumeId} on {url}: "
-                      f"{resp.processed} bytes -> {args.dest} "
-                      f"({resp.processed_percentage:.0f}%)\n")
+        try:
+            env.volume_server(url).VolumeMarkReadonly(
+                volume_server_pb2.VolumeMarkReadonlyRequest(
+                    volume_id=args.volumeId))
+        except _grpc.RpcError as e:
+            # an EC vid has no normal volume to seal — its shards are
+            # sealed by construction; anything else is a real failure
+            if e.code() != _grpc.StatusCode.NOT_FOUND:
+                raise
+        try:
+            for resp in env.volume_server(url).VolumeTierMoveDatToRemote(
+                    volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
+                        volume_id=args.volumeId,
+                        destination_backend_name=args.dest,
+                        keep_local_dat_file=args.keepLocalDatFile)):
+                out.write(f"volume {args.volumeId} on {url}: "
+                          f"{resp.processed} bytes -> {args.dest} "
+                          f"({resp.processed_percentage:.0f}%)\n")
+        except _grpc.RpcError as e:
+            if "already tiered" in (e.details() or ""):
+                out.write(f"volume {args.volumeId} on {url}: "
+                          f"already tiered, skipped\n")
+                continue
+            raise
 
 
-@command("volume.tier.download", "bring a cloud-tiered volume's .dat back "
-                                 "to local disk")
+@command("volume.lifecycle", "status / pause / force the heat-driven "
+                             "lifecycle policy engine")
+def volume_lifecycle(env: CommandEnv, argv: List[str], out) -> None:
+    """Control plane for the master's lifecycle engine
+    (seaweedfs_tpu/lifecycle/): print the state machine's status (the
+    default), pause/resume the policy loop, or force one volume
+    through a transition (bypasses thresholds and dwell, still honors
+    dry-run). Talks to the master's /cluster/lifecycle endpoint, which
+    proxies to the raft leader like every master HTTP verb."""
+    import json as _json
+
+    from seaweedfs_tpu.util import http_client
+    p = argparse.ArgumentParser(prog="volume.lifecycle")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("-status", action="store_true",
+                   help="print engine status (default)")
+    g.add_argument("-pause", action="store_true",
+                   help="hold the policy loop (no new transitions)")
+    g.add_argument("-resume", action="store_true")
+    g.add_argument("-force", action="store_true",
+                   help="queue one forced transition now")
+    p.add_argument("-volumeId", type=int, default=0,
+                   help="volume for -force")
+    p.add_argument("-target", default="",
+                   help="target state for -force: hot | warm | cold")
+    args = p.parse_args(argv)
+
+    def call(method="GET", **params):
+        q = "&".join(f"{k}={v}" for k, v in params.items())
+        resp = http_client.request(
+            method, f"{env.master_url}/cluster/lifecycle"
+                    + (f"?{q}" if q else ""), timeout=30)
+        body = _json.loads(resp.body)
+        if body.get("error"):
+            raise RuntimeError(body["error"])
+        return body
+
+    if args.pause:
+        call("POST", action="pause")
+        out.write("lifecycle paused\n")
+        return
+    if args.resume:
+        call("POST", action="resume")
+        out.write("lifecycle resumed\n")
+        return
+    if args.force:
+        if not args.volumeId or not args.target:
+            raise ValueError("-force needs -volumeId and -target")
+        body = call("POST", action="force", volumeId=args.volumeId,
+                    target=args.target)
+        out.write(f"volume {args.volumeId}: {body['queued']} queued\n")
+        return
+    st = call()
+    if not st.get("enabled"):
+        out.write("lifecycle disabled (start the master with "
+                  "-lifecycle)\n")
+        return
+    states = st.get("states", {})
+    out.write(
+        f"lifecycle: {'PAUSED' if st.get('paused') else 'running'}"
+        f"{' (dry run)' if st.get('dry_run') else ''} "
+        f"passes:{st.get('passes', 0)} "
+        f"interval:{st.get('interval_s', 0):.0f}s\n"
+        f"volumes: hot:{states.get('hot', 0)} "
+        f"warm:{states.get('warm', 0)} cold:{states.get('cold', 0)}\n"
+        f"transitions: ok:{st.get('transitions_ok', 0)} "
+        f"err:{st.get('transitions_err', 0)} "
+        f"queued:{st.get('queued_forced', 0)}\n")
+    for d in st.get("decisions", [])[-10:]:
+        out.write(f"  vol {d['vid']}: {d['kind']} -> {d['target']} "
+                  f"[{d['outcome']}] {d['reason']}\n")
+
+
+@command("volume.tier.download", "bring a cloud-tiered volume's .dat (or "
+                                 "EC shards) back to local disk")
 def volume_tier_download(env: CommandEnv, argv: List[str], out) -> None:
-    """Reference: weed/shell/command_volume_tier_download.go."""
+    """Reference: weed/shell/command_volume_tier_download.go.
+
+    Idempotent over holders, mirroring volume.tier.upload: a holder
+    whose copy is already local is SKIPPED instead of aborting the
+    remaining-holder loop — a retry after a partial download failure
+    (the lifecycle engine re-runs the same command after backoff)
+    finishes the stragglers instead of wedging on the ones done."""
+    import grpc as _grpc
     p = argparse.ArgumentParser(prog="volume.tier.download")
     p.add_argument("-volumeId", type=int, required=True)
     p.add_argument("-keepRemoteDatFile", action="store_true")
     args = p.parse_args(argv)
     for url in env.lookup(args.volumeId):
-        for resp in env.volume_server(url).VolumeTierMoveDatFromRemote(
-                volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
-                    volume_id=args.volumeId,
-                    keep_remote_dat_file=args.keepRemoteDatFile)):
-            out.write(f"volume {args.volumeId} on {url}: "
-                      f"{resp.processed} bytes restored\n")
+        try:
+            for resp in env.volume_server(url).VolumeTierMoveDatFromRemote(
+                    volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
+                        volume_id=args.volumeId,
+                        keep_remote_dat_file=args.keepRemoteDatFile)):
+                out.write(f"volume {args.volumeId} on {url}: "
+                          f"{resp.processed} bytes restored\n")
+        except _grpc.RpcError as e:
+            if "not cloud-tiered" in (e.details() or ""):
+                out.write(f"volume {args.volumeId} on {url}: "
+                          f"already local, skipped\n")
+                continue
+            raise

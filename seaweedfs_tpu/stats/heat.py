@@ -24,12 +24,23 @@ latency window makes). No threads, ever.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 import weakref
 from typing import Dict, List, Optional
 
 BUCKETS = 8
+# EWMA time constant in units of the heat window: sized so a sustained
+# rate change converges in ~3-4 windows while a single-pulse spike
+# moves the average only fractionally (the lifecycle's hysteresis
+# partner — thresholds compare against BOTH the instantaneous window
+# and this decayed rate)
+EWMA_TAU_WINDOWS = 2.0
+# below this decayed rate (one read per ~17 minutes) the EWMA snaps to
+# an exact 0.0: exponential decay otherwise never reaches zero, which
+# would make the lifecycle's default coolThreshold=0 unreachable
+EWMA_ZERO = 1e-3
 
 # Live trackers + the vids with a registered gauge child. The gauge's
 # per-vid callable sums over LIVE trackers via this weak set, so a
@@ -57,13 +68,20 @@ def _register_vid_gauge(vid: int) -> None:
 
 
 class _VolHeat:
-    __slots__ = ("stamps", "counts", "total", "needles")
+    __slots__ = ("stamps", "counts", "total", "needles", "ewma",
+                 "ewma_ts")
 
     def __init__(self):
         self.stamps = [0] * BUCKETS     # which time slot each bucket holds
         self.counts = [0] * BUCKETS
         self.total = 0
         self.needles: Dict[int, int] = {}
+        # decayed average of the window-read rate, updated lazily at
+        # summary() time (the heartbeat cadence): the policy engine's
+        # anti-flap signal — a one-pulse burst barely moves it, a
+        # sustained change converges within a few windows
+        self.ewma = 0.0
+        self.ewma_ts = 0.0
 
 
 class HeatTracker:
@@ -113,6 +131,25 @@ class HeatTracker:
         waiting for the GC."""
         _TRACKERS.discard(self)
 
+    def forget(self, vid: int) -> None:
+        """Drop everything tracked for a volume that left this server
+        (delete, unmount, EC conversion). Without this a dead vid's
+        `SeaweedFS_volume_heat{vid}` child and needle counters linger
+        forever — unbounded label growth, the exact cardinality smell
+        the `metric` lint polices. The gauge child is unregistered only
+        once NO live tracker still holds the vid (two in-process
+        servers may share one)."""
+        with self._lock:
+            self._vols.pop(vid, None)
+        if any(vid in t._vols for t in list(_TRACKERS)):
+            return
+        with _reg_lock:
+            if vid not in _registered_vids:
+                return
+            _registered_vids.discard(vid)
+        from seaweedfs_tpu.stats.metrics import VolumeHeatGauge
+        VolumeHeatGauge.remove(str(vid))
+
     # -- read side ------------------------------------------------------------
 
     def window_reads(self, vid: int) -> int:
@@ -125,6 +162,38 @@ class HeatTracker:
         newest = int(time.monotonic() / self.bucket_s)
         return sum(c for s, c in zip(v.stamps, v.counts)
                    if newest - s < BUCKETS)
+
+    def summary(self) -> List[dict]:
+        """The heartbeat heat payload: per-vid window reads plus the
+        decayed EWMA of the window-read rate (reads/s). Called once per
+        pulse; the EWMA decays with time constant EWMA_TAU_WINDOWS heat
+        windows, so it keeps falling while a volume sits idle (no reads
+        means no record() calls, but the heartbeat still reports the
+        cooling trajectory)."""
+        now = time.monotonic()
+        out = []
+        for vid in list(self._vols):
+            v = self._vols.get(vid)
+            if v is None:
+                continue
+            rate = self.window_reads(vid) / self.window_s
+            if v.ewma_ts == 0.0:
+                v.ewma = rate
+            else:
+                tau = EWMA_TAU_WINDOWS * self.window_s
+                alpha = 1.0 - math.exp(-(now - v.ewma_ts) / tau)
+                v.ewma += alpha * (rate - v.ewma)
+                if v.ewma < EWMA_ZERO:
+                    # exponential decay never reaches 0.0 (a once-read
+                    # volume would carry a denormal for ~a day) — snap
+                    # to an honest zero so a coolThreshold of 0 can
+                    # actually be met by an idle volume
+                    v.ewma = 0.0
+            v.ewma_ts = now
+            out.append({"id": vid,
+                        "reads_window": self.window_reads(vid),
+                        "ewma": v.ewma})
+        return out
 
     def hot_needles(self, vid: int) -> List[List]:
         v = self._vols.get(vid)

@@ -62,6 +62,15 @@ class _Metric:
                 self._children[values] = child
             return child
 
+    def remove(self, *values: str) -> bool:
+        """Drop one labeled child from the exposition (label hygiene:
+        a deleted volume's per-vid gauge must not linger forever — the
+        unbounded-cardinality failure mode the `metric` lint polices).
+        Returns True when a child was present."""
+        values = tuple(str(v) for v in values)
+        with self._lock:
+            return self._children.pop(values, None) is not None
+
     def _default(self):
         return self.labels() if not self.label_names else None
 
@@ -503,6 +512,33 @@ TraceLiveGauge = REGISTRY.gauge(
 VolumeHeatGauge = REGISTRY.gauge(
     "SeaweedFS_volume_heat",
     "reads of this volume within the sliding heat window", ("vid",))
+
+# Heat-driven lifecycle families (seaweedfs_tpu/lifecycle/): the policy
+# engine's ledger — what it decided, what it moved, and where every
+# volume sits in the hot/warm/cold lattice right now. The cluster heat
+# gauge is the master-side aggregate of every volume server's
+# heartbeat-carried HeatTracker summary.
+ClusterVolumeHeatGauge = REGISTRY.gauge(
+    "SeaweedFS_cluster_volume_heat",
+    "cluster-wide reads of this volume within the heat window "
+    "(summed over the heartbeat heat map)", ("vid",))
+LifecycleTransitionsCounter = REGISTRY.counter(
+    "SeaweedFS_lifecycle_transitions_total",
+    "lifecycle transitions by kind (encode | decode | offload | "
+    "download) and outcome (ok | error | dry_run)", ("kind", "outcome"))
+LifecycleQueueDepthGauge = REGISTRY.gauge(
+    "SeaweedFS_lifecycle_queue_depth",
+    "transitions planned or forced but not yet executed")
+LifecycleBytesMovedCounter = REGISTRY.counter(
+    "SeaweedFS_lifecycle_bytes_moved_total",
+    "volume bytes moved across tiers by the policy engine", ("kind",))
+LifecycleVolumeStatesGauge = REGISTRY.gauge(
+    "SeaweedFS_lifecycle_volume_states",
+    "volumes currently tracked in each lifecycle state", ("state",))
+LifecyclePassSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_lifecycle_pass_seconds",
+    "wall time of one policy pass including executed transitions",
+    buckets=(0.001, 0.01, 0.1, 1, 10, 60, 600, 3600))
 
 # Process self-telemetry: evaluated at scrape time only (callable
 # gauges), so every bench gets RSS/fd/thread/GC correlation for free.

@@ -331,3 +331,46 @@ def remove_tier_info(base_name: str) -> None:
     p = tier_info_path(base_name)
     if os.path.exists(p):
         os.remove(p)
+
+
+# ---------------------------------------------------------------------------
+# EC tier metadata file (<base>.ectier): which backend holds this
+# server's .ecNN shard files — the erasure-coded sibling of the .tier
+# sidecar above. `shards` maps shard id -> {key, size}; the .ecx/.ecj
+# index always stays local (like the .idx on a tiered .dat), so needle
+# lookups keep their speed and only bulk shard reads pay the remote
+# round trip.
+# ---------------------------------------------------------------------------
+
+
+def ec_tier_info_path(base_name: str) -> str:
+    return base_name + ".ectier"
+
+
+def write_ec_tier_info(base_name: str, backend_name: str,
+                       shards: dict) -> None:
+    info = {"backend": backend_name,
+            "shards": {str(sid): rec for sid, rec in shards.items()}}
+    tmp = ec_tier_info_path(base_name) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(info, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, ec_tier_info_path(base_name))
+
+
+def read_ec_tier_info(base_name: str) -> Optional[dict]:
+    p = ec_tier_info_path(base_name)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        info = json.load(f)
+    info["shards"] = {int(sid): rec
+                      for sid, rec in info.get("shards", {}).items()}
+    return info
+
+
+def remove_ec_tier_info(base_name: str) -> None:
+    p = ec_tier_info_path(base_name)
+    if os.path.exists(p):
+        os.remove(p)

@@ -74,6 +74,22 @@ class DiskLocation:
         for name in sorted(os.listdir(self.directory)):
             parsed = parse_ec_shard_filename(name)
             if parsed is None:
+                # cloud-tiered EC shards: the .ecNN files are gone but
+                # the .ectier sidecar records which backend holds them
+                # — remount them remote so a restarted server keeps
+                # serving its COLD volumes (EcVolume._remote_info
+                # resolves each shard's backend handle)
+                if name.endswith(".ectier"):
+                    stem = name[:-len(".ectier")]
+                    col, _, tail = stem.rpartition("_")
+                    if tail.isdigit():
+                        from seaweedfs_tpu.storage.backend import \
+                            read_ec_tier_info
+                        info = read_ec_tier_info(
+                            os.path.join(self.directory, stem))
+                        for sid in (info or {}).get("shards", {}):
+                            found.setdefault(
+                                int(tail), (col, []))[1].append(int(sid))
                 continue
             col, vid, shard = parsed
             found.setdefault(vid, (col, []))[1].append(shard)

@@ -19,14 +19,19 @@ from seaweedfs_tpu.util import wlog
 _log = wlog.logger("storage.tier")
 
 
-def _tier_key(v: Volume, owner: str = "") -> str:
-    """Object key for a volume's .dat. `owner` (the uploading server's
-    url) keeps replicas of the same volume from clobbering each other's
-    objects — replica .dat files are NOT byte-identical (append
-    timestamps and write order differ per server)."""
-    name = f"{v.collection}_{v.id}" if v.collection else str(v.id)
+def _key_stem(collection: str, vid: int, owner: str = "") -> str:
+    """Shared object-key stem for a volume's tiered files. `owner`
+    (the uploading server's url) keeps replicas/shard-holders of the
+    same volume from clobbering each other's objects — replica .dat
+    files are NOT byte-identical (append timestamps and write order
+    differ per server), and each holder owns different shards."""
+    name = f"{collection}_{vid}" if collection else str(vid)
     prefix = f"volumes/{owner.replace(':', '_')}/" if owner else "volumes/"
-    return f"{prefix}{name}.dat"
+    return prefix + name
+
+
+def _tier_key(v: Volume, owner: str = "") -> str:
+    return f"{_key_stem(v.collection, v.id, owner)}.dat"
 
 
 def move_dat_to_remote(v: Volume, backend_name: str,
@@ -96,4 +101,106 @@ def move_dat_from_remote(v: Volume, keep_remote: bool = False,
         storage.delete_file(info["key"])
     _log.info("volume %d un-tiered from %s (%d bytes)",
               v.id, info["backend"], total)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# EC shard tiering: the COLD leg of the heat-driven lifecycle. Same
+# contract as the .dat path above — the index (.ecx/.ecj) stays local,
+# only the bulk .ecNN bytes move, and reads keep flowing throughout
+# (shard files are immutable once generated, so uploads run without
+# any lock; only the per-shard handle swap synchronizes).
+# ---------------------------------------------------------------------------
+
+
+def _ec_shard_key(ecv, shard_id: int, owner: str = "") -> str:
+    return f"{_key_stem(ecv.collection, ecv.volume_id, owner)}" \
+           f".ec{shard_id:02d}"
+
+
+def move_ec_shards_to_remote(ecv, backend_name: str,
+                             keep_local: bool = False,
+                             owner: str = "",
+                             progress: Optional[Callable[[int], None]] = None
+                             ) -> int:
+    """Upload every LOCAL shard of this EC volume to the backend,
+    record them in the <base>.ectier sidecar, swap reads over, and
+    (by default) drop the local shard files. Shards already remote are
+    skipped, so re-runs are idempotent — the lifecycle policy loop
+    re-offloads COLD volumes it forgot across a master restart.
+    Returns bytes uploaded."""
+    local = {sid: s for sid, s in sorted(ecv.shards.items())
+             if not s.is_remote}
+    if not local:
+        raise VolumeError(
+            f"volume {ecv.volume_id} is already tiered")
+    storage = bk.get_backend(backend_name)
+    prior = bk.read_ec_tier_info(ecv.base_name)
+    if prior is not None and prior["backend"] != backend_name:
+        raise VolumeError(
+            f"volume {ecv.volume_id}: shards already tiered to "
+            f"{prior['backend']!r}; download them before re-tiering "
+            f"to {backend_name!r}")
+    uploaded = {}
+    total = 0
+    try:
+        for sid, shard in local.items():
+            key = _ec_shard_key(ecv, sid, owner)
+            n = storage.copy_file(shard.path, key, progress=progress)
+            if n != shard.size:
+                raise VolumeError(
+                    f"volume {ecv.volume_id} shard {sid}: uploaded "
+                    f"{n} bytes != local {shard.size}")
+            uploaded[sid] = {"key": key, "size": n}
+            total += n
+    except (VolumeError, bk.BackendError):
+        for rec in uploaded.values():   # no half-tiered sidecar
+            storage.delete_file(rec["key"])
+        raise
+    merged = dict((prior or {}).get("shards", {}))
+    merged.update(uploaded)
+    bk.write_ec_tier_info(ecv.base_name, backend_name, merged)
+    for sid, rec in uploaded.items():
+        shard = ecv.shards[sid]
+        shard.swap_to_remote(storage, rec["key"], rec["size"])
+        if not keep_local and os.path.exists(shard.path):
+            os.remove(shard.path)
+    _log.info("ec volume %d: %d shard(s) tiered to %s (%d bytes, "
+              "keep_local=%s)", ecv.volume_id, len(uploaded),
+              backend_name, total, keep_local)
+    return total
+
+
+def move_ec_shards_from_remote(ecv, keep_remote: bool = False,
+                               progress: Optional[Callable[[int], None]]
+                               = None) -> int:
+    """Download this server's tiered shards back next to their .ecx
+    and resume local reads (the COLD->WARM leg). Returns bytes
+    restored."""
+    info = bk.read_ec_tier_info(ecv.base_name)
+    if info is None:
+        raise VolumeError(
+            f"volume {ecv.volume_id} is not cloud-tiered")
+    storage = bk.get_backend(info["backend"])
+    total = 0
+    for sid, rec in sorted(info["shards"].items()):
+        shard = ecv.shards.get(sid)
+        if shard is None or not shard.is_remote:
+            continue
+        tmp = shard.path + ".tiertmp"
+        n = storage.download_file(rec["key"], tmp, progress=progress)
+        if n != rec["size"]:
+            os.remove(tmp)
+            raise VolumeError(
+                f"volume {ecv.volume_id} shard {sid}: downloaded {n} "
+                f"bytes != recorded {rec['size']}")
+        os.replace(tmp, shard.path)
+        shard.swap_to_local()
+        total += n
+    bk.remove_ec_tier_info(ecv.base_name)
+    if not keep_remote:
+        for rec in info["shards"].values():
+            storage.delete_file(rec["key"])
+    _log.info("ec volume %d: shards un-tiered from %s (%d bytes)",
+              ecv.volume_id, info["backend"], total)
     return total

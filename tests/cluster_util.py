@@ -44,12 +44,14 @@ class Cluster:
                  with_filer: bool = False,
                  filer_kwargs: Optional[dict] = None,
                  volume_kwargs: Optional[dict] = None,
+                 master_kwargs: Optional[dict] = None,
                  racks: Optional[List[str]] = None):
         self.master = MasterServer(
             port=free_port_pair(),
             meta_dir=str(tmp_path / "master"),
             volume_size_limit_mb=volume_size_limit_mb,
-            pulse_seconds=pulse_seconds)
+            pulse_seconds=pulse_seconds,
+            **(master_kwargs or {}))
         self.master.start()
         self.volume_servers: List[VolumeServer] = []
         self.filer = None

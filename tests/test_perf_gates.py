@@ -613,6 +613,77 @@ def test_cluster_trace_disabled_overhead(tmp_path):
         f"heat record costs {per_call * 1e6:.2f} us/call"
 
 
+def test_lifecycle_disabled_overhead(tmp_path):
+    """The heat-driven lifecycle must be zero-cost while disabled
+    (ISSUE 9 tentpole contract, the scrub/trace twin for the policy
+    engine).
+
+    Gates. Construction: a default-config master holds NO engine
+    object and spawns no lifecycle thread — ever, not merely "not
+    yet". Wire: a heat-less heartbeat serializes byte-identically to
+    the pre-lifecycle format (field 17 absent), so heat-disabled
+    clusters pay zero heartbeat bytes. Read path: the only lifecycle
+    hook on the read path is the pre-existing -heat.track None check,
+    asserted at one-flag-check cost."""
+    import threading
+
+    from seaweedfs_tpu.pb import master_pb2
+    from seaweedfs_tpu.server import convert
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.store import Store
+
+    def lifecycle_threads():
+        return [t.name for t in threading.enumerate()
+                if "lifecycle" in t.name.lower()]
+
+    ms = MasterServer(port=39991, meta_dir=str(tmp_path / "m"))
+    assert ms.lifecycle is None, \
+        "default-config master must not construct a lifecycle engine"
+    assert lifecycle_threads() == [], \
+        "a lifecycle thread exists without -lifecycle"
+
+    # heartbeat byte-identity: a store's heartbeat through the full
+    # convert path (heat absent) must serialize to EXACTLY the wire
+    # bytes a pre-lifecycle Heartbeat message produces
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)],
+                      degraded_fleet=False)
+    assert vs.heat is None
+    vs.store.add_volume(1)
+    from seaweedfs_tpu.storage.needle import Needle
+    vs.store.write_needle(1, Needle(id=1, cookie=9, data=b"hb"))
+    hb = vs.store.collect_heartbeat()
+    assert "volume_heats" not in hb, \
+        "heat-disabled heartbeat dicts must not carry a heat key"
+    got = convert.heartbeat_to_pb(hb, "dc", "r").SerializeToString()
+    want = master_pb2.Heartbeat(
+        ip=hb["ip"], port=hb["port"],
+        public_url=hb.get("public_url", ""),
+        max_volume_count=hb.get("max_volume_count", 0),
+        max_file_key=hb.get("max_file_key", 0),
+        data_center="dc", rack="r",
+        volumes=[convert.volume_info_to_pb(v)
+                 for v in hb.get("volumes", [])],
+        ec_shards=[convert.ec_info_to_pb(e)
+                   for e in hb.get("ec_shards", [])]).SerializeToString()
+    assert got == want, \
+        "heat-disabled heartbeat must be byte-identical to the " \
+        "pre-lifecycle wire format"
+
+    # read path: the lifecycle's only read-side branch is the
+    # -heat.track None check — one attribute test per read
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if vs.heat is not None:
+            raise AssertionError("default server grew a heat tracker")
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 2e-6, \
+        f"disabled heat check costs {per_call * 1e6:.3f} us/call"
+    vs.store.close()
+
+
 def test_scrub_disabled_overhead(tmp_path):
     """Scrub must be zero-cost while disabled (ISSUE 3 contract, the
     test_tracing_disabled_overhead twin for the integrity subsystem).
