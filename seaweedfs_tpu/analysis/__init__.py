@@ -5,9 +5,11 @@ deadlock-revealing scheduler free from the Go toolchain; this package
 is the Python-side stand-in. It walks every module under
 `seaweedfs_tpu/` and enforces the repo's concurrency and hygiene house
 rules as named, allowlistable AST checks (engine.py / invariants.py /
-deadcode.py — catalog in ARCHITECTURE.md "Static analysis &
-sanitizers"), paired with the runtime half in `util/sanitizer.py`
-(lock-order cycles + hold-time watchdog, armed by SEAWEED_SANITIZE=1).
+deadcode.py / guards.py — catalog in ARCHITECTURE.md "Static analysis
+& sanitizers"), paired with the runtime halves in `util/sanitizer.py`
+(lock-order cycles + hold-time watchdog, armed by SEAWEED_SANITIZE=1)
+and `util/scheduler.py` (ISSUE 10: deterministic schedule exploration
+with exact seeded replay of failing interleavings).
 
 Runs as tier-1 tests (tests/test_static_analysis.py) so every future
 PR is checked, and as `bench.py --lint` for the timing gate (< 30 s
@@ -33,6 +35,15 @@ than allowlisted (ISSUE 8 satellite; one line each):
     storage/disk_location logs the volume it skips
   - tree-wide: 40 dead imports, 2 dead locals, and a
     placeholder-less f-string removed (check `dead`)
+  - (ISSUE 10, check `guard`) scrub/daemon.stop: _stopping flipped and
+    _thread read under the lock — the unlocked write let a racing
+    start()'s fresh pass thread outlive shutdown (explorer regression
+    test with its failing seed in tests/test_scheduler.py)
+  - (ISSUE 10) reads/decode_fleet.stop: dispatcher/pool/workers
+    snapshotted under _start_lock so a first-request _ensure_started
+    can never escape the shutdown join
+  - (ISSUE 10) filesys/wfs.release: handle-table pop moved under the
+    handle lock
 
 Usage:
     python -m seaweedfs_tpu.analysis          # human report, exit 1 on findings

@@ -65,6 +65,10 @@ class Source:
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=rel)
         self.pragmas: Dict[int, List[Pragma]] = {}
+        # every comment, line -> (text, own_line) — the guard check
+        # reads its `# guarded_by(...)` / `# requires(...)` grammar
+        # out of this map
+        self.comments: Dict[int, Tuple[str, bool]] = {}
         self._scan_pragmas()
 
     def _scan_pragmas(self) -> None:
@@ -78,6 +82,7 @@ class Source:
                 row, col = tok.start
                 own = not self.lines[row - 1][:col].strip() \
                     if row <= len(self.lines) else False
+                self.comments[row] = (tok.string, own)
                 for m in PRAGMA_RE.finditer(tok.string):
                     p = Pragma(m.group(1), m.group(2).strip(), row,
                                own_line=own)
@@ -142,7 +147,8 @@ def iter_sources(root: Optional[Path] = None) -> List[Source]:
 def _load_checks() -> None:
     # the check modules register themselves on import
     # lint: dead-ok(side-effect import registers the checks)
-    from seaweedfs_tpu.analysis import deadcode, invariants  # noqa: F401
+    from seaweedfs_tpu.analysis import (deadcode, guards,  # noqa: F401
+                                        invariants)
 
 
 def run_checks(root: Optional[Path] = None,

@@ -73,8 +73,9 @@ class SegmentedLRU:
             else max(1, self.limit // MAX_ITEM_FRACTION)
         self._on_evict = on_evict
         self._lock = threading.Lock()
-        self._probation: "OrderedDict[str, bytes]" = OrderedDict()
-        self._protected: "OrderedDict[str, bytes]" = OrderedDict()
+        # __len__ peeks lock-free (stats); every mutation is locked
+        self._probation: "OrderedDict[str, bytes]" = OrderedDict()  # guarded_by(self._lock, writes)
+        self._protected: "OrderedDict[str, bytes]" = OrderedDict()  # guarded_by(self._lock, writes)
         self._probation_bytes = 0
         self._protected_bytes = 0
         self.evictions = 0
@@ -140,7 +141,7 @@ class SegmentedLRU:
                 self._probation_bytes -= len(v)
             return v
 
-    def _shrink_protected(self) -> None:
+    def _shrink_protected(self) -> None:  # requires(self._lock)
         # protected overflow demotes its LRU back to probation MRU —
         # it gets one more lap to prove it is still hot
         while self._protected_bytes > self.protected_limit \
@@ -150,7 +151,7 @@ class SegmentedLRU:
             self._probation[k] = v
             self._probation_bytes += len(v)
 
-    def _shrink_total(self) -> None:
+    def _shrink_total(self) -> None:  # requires(self._lock)
         while self.bytes > self.limit:
             if self._probation:
                 k, v = self._probation.popitem(last=False)
@@ -317,8 +318,8 @@ class TieredReadCache:
 
     # -- tiers --------------------------------------------------------------
 
-    def _demoted(self, key: str, value: bytes, protected: bool) -> None:
-        # runs under self._lock (every mem mutation goes through our
+    def _demoted(self, key: str, value: bytes, protected: bool) -> None:  # requires(self._lock)
+        # (every mem mutation goes through our
         # public methods) — protected evictions were hot once and spill
         # to disk; probation evictions are scan traffic and just leave.
         # The disk write itself is QUEUED: file IO under the cache (and
@@ -395,8 +396,8 @@ class TieredReadCache:
         finally:
             self._flush_demotions()
 
-    def _gen_of(self, key: str) -> Tuple[int, int]:
-        """(volume generation, key fence) — call under self._lock."""
+    def _gen_of(self, key: str) -> Tuple[int, int]:  # requires(self._lock)
+        """(volume generation, key fence)."""
         return (self._gen.get(self._vid_of(key), 0),
                 self._fence.get(key, 0))
 
@@ -440,8 +441,8 @@ class TieredReadCache:
         if self.disk is not None:
             CacheBytesGauge.labels("disk").set(self.disk.bytes)
 
-    def _maybe_prune_index(self) -> None:
-        """Amortized _by_vid hygiene (call under self._lock): disk-tier
+    def _maybe_prune_index(self) -> None:  # requires(self._lock)
+        """Amortized _by_vid hygiene: disk-tier
         LRU evictions can't call back into this index (victim filenames
         are hashes), so keys that left BOTH tiers would otherwise
         accumulate without bound on long-running servers."""

@@ -76,7 +76,10 @@ class EcVolumeShard:
         name = f"{collection}_{vid}" if collection else str(vid)
         self.path = shard_file_name(os.path.join(directory, name), shard_id)
         self._lock = threading.Lock()
-        self._remote = None          # (BackendStorage, key) when tiered
+        # read_at's lock-free fast path reads this once and falls back
+        # to the local file under the lock when a concurrent download
+        # leg swapped the shard mid-read (PR 9 review contract)
+        self._remote = None  # guarded_by(self._lock, writes)   (BackendStorage, key) when tiered
         if remote is not None:
             storage, key, size = remote
             self._remote = (storage, key)
@@ -178,7 +181,11 @@ class EcVolume:
         arr = idx_codec.parse_index_bytes(self._ecx.read())
         self._keys = arr["key"].copy()
         self._offsets = arr["offset"].copy()
-        self._sizes = arr["size"].copy()
+        # find_needle/file_count read lock-free (single-element numpy
+        # stores are atomic under the GIL; a read racing a tombstone
+        # sees either value, both valid); mutation takes the lock
+        # lint: guard-ok(_load_ecx runs from __init__ only, before the volume is published)
+        self._sizes = arr["size"].copy()  # guarded_by(self._lock, writes)
 
     def find_needle(self, needle_id: int) -> Tuple[int, int]:
         """Return (dat_offset, size); raises NeedleError if absent/deleted."""

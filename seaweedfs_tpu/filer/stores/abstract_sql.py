@@ -74,15 +74,15 @@ class AbstractSqlStore(FilerStore):
     def _p(self) -> str:
         return "?" if self.paramstyle == "qmark" else "%s"
 
-    def _exec(self, sql: str, args: tuple = ()):  # caller holds lock
+    def _exec(self, sql: str, args: tuple = ()):  # requires(self._lock)
         cur = self._conn.cursor()
         cur.execute(sql, args)
         return cur
 
-    def _commit(self):
+    def _commit(self):  # requires(self._lock)
         self._conn.commit()
 
-    def _maybe_commit(self):
+    def _maybe_commit(self):  # requires(self._lock)
         if not self._in_tx:
             self._commit()
 
@@ -169,15 +169,18 @@ class AbstractSqlStore(FilerStore):
 
     def begin_transaction(self):
         self._lock.acquire()
+        # lint: guard-ok(the acquire above holds the lock across the tx; a with-block cannot span it)
         self._in_tx += 1
 
-    def commit_transaction(self):
+    def commit_transaction(self):  # requires(self._lock)
+        # the lock was taken by begin_transaction (acquire/release
+        # spans the tx, which `with` cannot express)
         self._in_tx -= 1
         if not self._in_tx:
             self._commit()
         self._lock.release()
 
-    def rollback_transaction(self):
+    def rollback_transaction(self):  # requires(self._lock)
         self._in_tx -= 1
         if not self._in_tx:
             self._conn.rollback()

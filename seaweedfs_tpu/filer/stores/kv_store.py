@@ -46,14 +46,16 @@ class LogKV:
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.RLock()
-        self._index: Dict[bytes, Tuple[int, int, int]] = {}
-        self._sorted: List[bytes] = []
-        self._fds: Dict[int, int] = {}     # segment id -> read fd
-        self._active_id = 0
-        self._active_fd = -1
-        self._active_off = 0
-        self._live_bytes = 0
-        self._total_bytes = 0
+        # __len__/stats may peek lock-free (GIL-atomic dict len); all
+        # mutation flows through the requires(self._lock) helpers below
+        self._index: Dict[bytes, Tuple[int, int, int]] = {}  # guarded_by(self._lock, writes)
+        self._sorted: List[bytes] = []  # guarded_by(self._lock)
+        self._fds: Dict[int, int] = {}  # guarded_by(self._lock)   segment id -> read fd
+        self._active_id = 0  # guarded_by(self._lock)
+        self._active_fd = -1  # guarded_by(self._lock)
+        self._active_off = 0  # guarded_by(self._lock)
+        self._live_bytes = 0  # guarded_by(self._lock)
+        self._total_bytes = 0  # guarded_by(self._lock, writes)
         self._replay()
         self._open_active()
 
@@ -72,7 +74,7 @@ class LogKV:
                     continue
         return sorted(ids)
 
-    def _replay(self) -> None:
+    def _replay(self) -> None:  # requires(self._lock)
         for seg_id in self._segment_ids():
             path = self._seg_path(seg_id)
             size = os.path.getsize(path)
@@ -112,7 +114,7 @@ class LogKV:
             _HEADER.size + len(k) + loc[2] + _CRC.size
             for k, loc in self._index.items())
 
-    def _open_active(self) -> None:
+    def _open_active(self) -> None:  # requires(self._lock)
         if not self._fds:
             self._active_id = 1
         path = self._seg_path(self._active_id)
@@ -125,7 +127,7 @@ class LogKV:
 
     # -- index ---------------------------------------------------------------
 
-    def _index_put(self, key: bytes, loc: Tuple[int, int, int]) -> None:
+    def _index_put(self, key: bytes, loc: Tuple[int, int, int]) -> None:  # requires(self._lock)
         if key not in self._index:
             bisect.insort(self._sorted, key)
         else:
@@ -134,7 +136,7 @@ class LogKV:
         self._index[key] = loc
         self._live_bytes += _HEADER.size + len(key) + loc[2] + _CRC.size
 
-    def _index_del(self, key: bytes) -> None:
+    def _index_del(self, key: bytes) -> None:  # requires(self._lock)
         old = self._index.pop(key, None)
         if old is not None:
             i = bisect.bisect_left(self._sorted, key)
@@ -144,7 +146,7 @@ class LogKV:
 
     # -- write path ----------------------------------------------------------
 
-    def _append(self, op: int, key: bytes, value: bytes) -> int:
+    def _append(self, op: int, key: bytes, value: bytes) -> int:  # requires(self._lock)
         header = _HEADER.pack(op, len(key), len(value))
         crc = zlib.crc32(header + key + value)
         rec = header + key + value + _CRC.pack(crc)
@@ -208,8 +210,7 @@ class LogKV:
 
     # -- compaction ----------------------------------------------------------
 
-    def _maybe_compact(self) -> None:
-        # caller holds the lock
+    def _maybe_compact(self) -> None:  # requires(self._lock)
         if self._total_bytes < self.COMPACT_MIN_BYTES or \
                 self._total_bytes < 2 * max(self._live_bytes, 1):
             return

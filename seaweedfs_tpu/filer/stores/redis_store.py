@@ -162,7 +162,9 @@ class RedisClusterClient:
                  timeout: float = 10.0):
         self._password = password
         self._timeout = timeout
-        self._conns = {}  # (host, port) -> RespClient
+        # refresh_slots iterates a lock-free snapshot (stale is fine —
+        # a dropped node just errors and is skipped); inserts/drops lock
+        self._conns = {}  # guarded_by(self._lock, writes)   (host, port) -> RespClient
         self._lock = threading.Lock()
         self._slots: List[tuple] = []  # (start, end, (host, port))
         self._seeds = []

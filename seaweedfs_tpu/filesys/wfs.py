@@ -143,8 +143,10 @@ class Wfs:
         self.meta_cache = MetaCache(filer_url, signature=self.signature)
         self.meta_cache.start_subscription(since_ns=time.time_ns())
         self.chunk_cache = TieredChunkCache(disk_dir=chunk_cache_dir)
-        self._handles: Dict[int, FileHandle] = {}
-        self._next_fh = 1
+        # fh keys are unique (allocated under the lock), so point
+        # lookups on the read/write path stay lock-free
+        self._handles: Dict[int, FileHandle] = {}  # guarded_by(self._lock, writes)
+        self._next_fh = 1  # guarded_by(self._lock)
         self._lock = threading.Lock()
 
     @property
@@ -235,7 +237,10 @@ class Wfs:
         self.handle(fh).flush()
 
     def release(self, fh: int) -> None:
-        h = self._handles.pop(fh, None)
+        # pop under the lock: a release racing open() must not drop a
+        # just-allocated sibling's table slot mid-resize (guard check)
+        with self._lock:
+            h = self._handles.pop(fh, None)
         if h is not None:
             h.release()
 

@@ -60,8 +60,8 @@ class LifecycleEngine:
         self._wake = threading.Event()
         self._stopping = False
         self._lock = threading.Lock()      # states/forced/decisions
-        self._forced: List[Transition] = []
-        self._decisions: List[dict] = []   # ring, newest last
+        self._forced: List[Transition] = []  # guarded_by(self._lock)
+        self._decisions: List[dict] = []  # guarded_by(self._lock)   ring, newest last
         self._failed_until: Dict[int, int] = {}  # vid -> pass number
         # last-known HOT size per vid: heartbeats carry no size for EC
         # shards, so WARM/COLD views (and therefore the byte budget and

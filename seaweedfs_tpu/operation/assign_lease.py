@@ -67,22 +67,24 @@ class LeaseCache:
         self.lease_ttl_s = lease_ttl_s
         self._assign_fn = assign_fn
         self._lock = threading.Lock()
-        self._pools: Dict[_PoolKey, Deque[_Lease]] = {}
-        self._refilling: set = set()
-        self._closed = False
+        self._pools: Dict[_PoolKey, Deque[_Lease]] = {}  # guarded_by(self._lock)
+        self._refilling: set = set()  # guarded_by(self._lock)
+        # lock-free reads are the drain-phase double-check; _bank
+        # re-checks under the lock before touching the pools
+        self._closed = False  # guarded_by(self._lock, writes)
         # single-flight for the MISS path: a cold pool hit by W pipeline
         # workers at once must cost one count=N round trip, not W
-        self._fill_locks: Dict[_PoolKey, threading.Lock] = {}
+        self._fill_locks: Dict[_PoolKey, threading.Lock] = {}  # guarded_by(self._lock)
         # ledger (exact under the lock; exported via the depth gauge)
         self.assign_round_trips = 0
         self.served_from_pool = 0
 
     # -- internals -------------------------------------------------------------
 
-    def _depth_locked(self) -> int:
+    def _depth_locked(self) -> int:  # requires(self._lock)
         return sum(len(p) for p in self._pools.values())
 
-    def _export_depth_locked(self) -> None:
+    def _export_depth_locked(self) -> None:  # requires(self._lock)
         from seaweedfs_tpu.stats.metrics import IngestLeaseDepthGauge
         IngestLeaseDepthGauge.set(self._depth_locked())
 

@@ -131,13 +131,16 @@ class DegradedReadFleet:
         self.batch_window_s = batch_window_s
         self.max_batch = max(1, max_batch)
         self.readers = max(1, readers)
-        self._rs: Optional[ReedSolomon] = None
+        # written once inside _ensure_started's locked section before
+        # the dispatcher spawns (happens-before via Thread.start), so
+        # worker-side reads are lock-free by design
+        self._rs: Optional[ReedSolomon] = None  # guarded_by(self._start_lock, writes)
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._start_lock = threading.Lock()
-        self._dispatcher: Optional[threading.Thread] = None
-        self._pool: Optional[ThreadPoolExecutor] = None
-        self._workers: Optional[ThreadPoolExecutor] = None
-        self._stopping = False
+        self._dispatcher: Optional[threading.Thread] = None  # guarded_by(self._start_lock, writes)
+        self._pool: Optional[ThreadPoolExecutor] = None  # guarded_by(self._start_lock, writes)
+        self._workers: Optional[ThreadPoolExecutor] = None  # guarded_by(self._start_lock, writes)
+        self._stopping = False  # guarded_by(self._start_lock, writes)
         # introspection for tests/bench: fused dispatches issued and
         # their occupancy (also exported via the Prometheus histogram)
         self.dispatches = 0
@@ -174,16 +177,25 @@ class DegradedReadFleet:
             self._dispatcher = t
 
     def stop(self) -> None:
+        # snapshot the machinery under the SAME lock that builds it: a
+        # stop() racing a first-request _ensure_started either sees the
+        # fully-built dispatcher/pools (and joins them) or wins the
+        # lock first, after which _ensure_started's _stopping check
+        # refuses to build — no window where a just-spawned dispatcher
+        # or pool escapes shutdown (guard-check finding, ISSUE 10)
         with self._start_lock:
             self._stopping = True
-            if self._dispatcher is None:
+            dispatcher = self._dispatcher
+            workers = self._workers
+            pool = self._pool
+            if dispatcher is None:
                 return
         self._q.put(None)
-        self._dispatcher.join(timeout=10)
-        if self._workers is not None:
-            self._workers.shutdown(wait=True)
-        if self._pool is not None:
-            self._pool.shutdown(wait=True)
+        dispatcher.join(timeout=10)
+        if workers is not None:
+            workers.shutdown(wait=True)
+        if pool is not None:
+            pool.shutdown(wait=True)
         # requests that slipped in between the dispatcher's final
         # drain and its exit must not wait out their 60s timeout
         self._fail_pending("decode fleet stopped")

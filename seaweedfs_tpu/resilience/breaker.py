@@ -40,7 +40,7 @@ _STATE_NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 enabled = False
 
 _lock = threading.Lock()
-_registry: Dict[str, "CircuitBreaker"] = {}
+_registry: Dict[str, "CircuitBreaker"] = {}  # guarded_by(_lock)
 _threshold = 5
 _cooldown_s = 5.0
 
@@ -66,11 +66,11 @@ class CircuitBreaker:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = cooldown_s
         self._lock = threading.Lock()
-        self._state = CLOSED
-        self._consecutive_failures = 0
-        self._opened_at = 0.0
-        self._probe_inflight = False
-        self._probe_started = 0.0
+        self._state = CLOSED  # guarded_by(self._lock)
+        self._consecutive_failures = 0  # guarded_by(self._lock)
+        self._opened_at = 0.0  # guarded_by(self._lock)
+        self._probe_inflight = False  # guarded_by(self._lock)
+        self._probe_started = 0.0  # guarded_by(self._lock)
         self._export(CLOSED)
 
     @property
@@ -131,8 +131,8 @@ class CircuitBreaker:
                     self._transition(OPEN, emit)
         self._emit(emit)
 
-    def _transition(self, to: int, emit: List[int]) -> None:
-        # caller holds self._lock; the metrics export is DEFERRED to
+    def _transition(self, to: int, emit: List[int]) -> None:  # requires(self._lock)
+        # the metrics export is DEFERRED to
         # _emit after release — labels()/set()/inc() take each family's
         # child-creation lock, and holding the breaker lock across a
         # foreign lock is exactly the lock-order edge the sanitizer
@@ -151,6 +151,7 @@ class CircuitBreaker:
         # than replaying this call's transition value: two calls whose
         # emits interleave out of order would otherwise leave the
         # gauge stale until the next transition (review finding)
+        # lint: guard-ok(deliberate racy read: exporting the CURRENT state is the fix for out-of-order emits)
         self._export(self._state)
 
     def _export(self, state: int) -> None:

@@ -66,7 +66,7 @@ _armed = False
 _http_control = False
 
 _lock = threading.Lock()
-_sites: Dict[str, List["_Spec"]] = {}
+_sites: Dict[str, List["_Spec"]] = {}  # guarded_by(_lock, writes)
 
 _ACTIONS = ("error", "delay", "short", "corrupt")
 

@@ -54,9 +54,10 @@ class Hedger:
         self.max_inflight = max(2, int(max_inflight))
         self._pool = FanOutPool(self.max_inflight, name)
         self._lock = threading.Lock()
-        self._lat: deque = deque(maxlen=_WINDOW)
-        self._since_recalc = 0
-        self._p95 = delay_floor_s
+        self._lat: deque = deque(maxlen=_WINDOW)  # guarded_by(self._lock)
+        self._since_recalc = 0  # guarded_by(self._lock)
+        # delay() reads the cached p95 lock-free on the hot path
+        self._p95 = delay_floor_s  # guarded_by(self._lock, writes)
         # ledger (mirrored in the SeaweedFS_hedge_* families)
         self.requests = 0
         self.hedges = 0
@@ -78,6 +79,7 @@ class Hedger:
         # every observed read's exit path, and two racing recalcs both
         # write a fresh-enough estimate (attribute store is atomic)
         ordered = sorted(snapshot)
+        # lint: guard-ok(deliberate unlocked store: racing recalcs both write a fresh-enough estimate)
         self._p95 = ordered[int(0.95 * (len(ordered) - 1))]
 
     def hedge_delay(self) -> float:

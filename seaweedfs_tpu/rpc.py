@@ -25,7 +25,10 @@ GRPC_PORT_OFFSET = 10000
 
 _channel_lock = threading.Lock()
 _channels: Dict[str, grpc.Channel] = {}
-_channel_generation = 0  # bumped on close_channels; invalidates stub cache
+# bumped on close_channels; invalidates the stub cache. make_stub's
+# lock-free read only keys the cache: a stale generation rebuilds a
+# stub against a closing channel, which the resilient-call retry absorbs
+_channel_generation = 0  # guarded_by(_channel_lock, writes)
 _stub_cache: Dict[tuple, object] = {}
 
 # process-wide TLS (security/tls.py configure_process_tls). None =

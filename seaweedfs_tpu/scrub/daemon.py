@@ -84,17 +84,21 @@ class ScrubDaemon:
         # can never serve a pre-repair cached blob
         self.on_repair = on_repair
         self._lock = threading.Lock()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # guarded_by(self._lock)
         self._resume = threading.Event()
         self._resume.set()            # not paused
         self._wake = threading.Event()  # interval sleep interrupt
-        self._stopping = False
+        # the pass thread polls these lock-free (loop conditions /
+        # status); every WRITE takes the lock so start/stop/pause
+        # serialize against each other
+        self._stopping = False  # guarded_by(self._lock, writes)
         # overrides for the FIRST pass of a freshly-started thread
         # only: a targeted/throttled start must never narrow or
-        # re-budget the later periodic passes
-        self._pass_volume_ids: Optional[List[int]] = None
-        self._pass_mbps: Optional[float] = None
-        self._state = "idle"
+        # re-budget the later periodic passes (written under the lock
+        # BEFORE the thread spawns — happens-before via Thread.start)
+        self._pass_volume_ids: Optional[List[int]] = None  # guarded_by(self._lock, writes)
+        self._pass_mbps: Optional[float] = None  # guarded_by(self._lock, writes)
+        self._state = "idle"  # guarded_by(self._lock, writes)
         self.current_volume_id = 0
         self.passes_completed = 0
         self.last_pass_unix = 0.0
@@ -160,13 +164,21 @@ class ScrubDaemon:
             return alive
 
     def stop(self) -> None:
-        self._stopping = True
+        # _stopping must flip under the lock: the unlocked write could
+        # land AFTER a concurrent start() passed its _stopping check
+        # but BEFORE it spawned — stop() would then join the OLD
+        # (dead) thread while a fresh pass thread sails on past
+        # shutdown (guard-check finding, ISSUE 10; regression test
+        # under the schedule explorer in tests/test_scheduler.py)
+        with self._lock:
+            self._stopping = True
+            t = self._thread
         self._resume.set()
         self._wake.set()
-        t = self._thread
         if t is not None:
             t.join(timeout=10)
-        self._state = "idle"
+        with self._lock:
+            self._state = "idle"
 
     def status(self) -> Dict:
         lag = self._scan_lag()
@@ -210,7 +222,9 @@ class ScrubDaemon:
                 break
             self._wake.wait(timeout=self.interval_s)
             self._wake.clear()
-        self._state = "idle"
+        with self._lock:
+            if not self._stopping:   # stop() owns the final state
+                self._state = "idle"
 
     def run_pass(self, volume_ids: Optional[Sequence[int]] = None,
                  mbps: Optional[float] = None) -> PassResult:

@@ -102,14 +102,15 @@ class RaftNode:
         self._match_index: Dict[str, int] = {}
         self._last_heard = time.monotonic()
         self._commit_cv = threading.Condition(self._lock)
-        self._stopped = False
+        # the ticker polls lock-free; stop() writes under the lock
+        self._stopped = False  # guarded_by(self._lock, writes)
         self._threads: List[threading.Thread] = []
         self._inflight: set = set()  # peers with a replicate RPC in flight
         # lint: thread-ok(consensus RPC fan-out pool; raft owns its own timeouts)
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, len(self.peers)),
             thread_name_prefix="raft-repl") if self.peers else None
-        self._wal_file = None
+        self._wal_file = None  # guarded_by(self._lock)
         self._wal_epoch = 0
         self._load_state()
 
@@ -161,7 +162,7 @@ class RaftNode:
         self._fsync_replace(p, json.dumps(
             {"term": self.current_term, "voted_for": self.voted_for}))
 
-    def _wal_path(self, epoch: Optional[int] = None) -> Optional[str]:
+    def _wal_path(self, epoch: Optional[int] = None) -> Optional[str]:  # requires(self._lock)
         """The WAL is generation-stamped: the snapshot records which
         epoch it pairs with, so a crash between writing the snapshot
         and cleaning the previous WAL can never replay STALE entries
@@ -170,7 +171,7 @@ class RaftNode:
         e = self._wal_epoch if epoch is None else epoch
         return self._path(f"raft.wal.{e}")
 
-    def _wal_handle(self):
+    def _wal_handle(self):  # requires(self._lock)
         if self._wal_file is None and self.meta_dir:
             os.makedirs(self.meta_dir, exist_ok=True)
             self._wal_file = open(self._wal_path(), "ab")
@@ -197,7 +198,7 @@ class RaftNode:
     def _wal_truncate_mark(self, from_index: int) -> None:
         self._wal_record({"op": "truncate", "from": from_index})
 
-    def _save_snapshot(self) -> None:
+    def _save_snapshot(self) -> None:  # requires(self._lock)
         """Write (new-epoch WAL tail, then snapshot naming it, then
         remove the old WAL). The snapshot write is the commit point:
         crash before it keeps the old (snap, WAL) pair intact; crash
@@ -210,7 +211,7 @@ class RaftNode:
         new_epoch = old_epoch + 1
         if self._wal_file is not None:
             self._wal_file.close()
-            self._wal_file = None
+            self._wal_file = None  # guarded_by(self._lock)
         payload = "".join(
             json.dumps({"op": "append", "entry": e}) + "\n"
             for e in self.log[1:])
@@ -225,7 +226,7 @@ class RaftNode:
         if os.path.exists(old):
             os.remove(old)
 
-    def _load_state(self) -> None:
+    def _load_state(self) -> None:  # requires(self._lock)
         if not self.meta_dir:
             return
         legacy = self._path("raft.json")
@@ -355,7 +356,7 @@ class RaftNode:
         with self._lock:
             if self._wal_file is not None:
                 self._wal_file.close()
-                self._wal_file = None
+                self._wal_file = None  # guarded_by(self._lock)
 
     # -- role accessors ------------------------------------------------------
 
@@ -440,7 +441,7 @@ class RaftNode:
         if self.is_leader:
             self._broadcast_heartbeat()
 
-    def _become_follower(self, term: int, leader: Optional[str]) -> None:
+    def _become_follower(self, term: int, leader: Optional[str]) -> None:  # requires(self._lock)
         # caller holds self._lock
         if term > self.current_term:
             self.current_term = term

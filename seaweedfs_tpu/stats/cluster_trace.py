@@ -81,10 +81,15 @@ slow_ms = 200.0           # floor for the tail-keep threshold
 sample = 0.0              # head-sample fraction (0..1)
 
 _lock = threading.Lock()
-_live: Dict[int, "TraceCtx"] = {}
+# live_count()/table snapshots read lock-free (flight-recorder views
+# may be one request stale); insert/remove lock
+_live: Dict[int, "TraceCtx"] = {}  # guarded_by(_lock, writes)
 _sampled: deque = deque(maxlen=SAMPLED_RING)
 _recent: deque = deque(maxlen=RECENT_RING)
-_p95: Dict[str, "_VerbP95"] = {}
+# per-verb trackers: finish() inserts via GIL-atomic setdefault on the
+# hot path (the tracker's own window lock guards its contents); only
+# reset() needs the module lock
+_p95: Dict[str, "_VerbP95"] = {}  # guarded_by(_lock, writes)
 _last_slow_log = 0.0
 
 
@@ -289,6 +294,7 @@ def finish(ctx: TraceCtx, exc: Optional[BaseException] = None,
     key = f"{ctx.role}.{ctx.verb}"
     tracker = _p95.get(key)
     if tracker is None:
+        # lint: guard-ok(setdefault is GIL-atomic; two racing finishes agree on one tracker)
         tracker = _p95.setdefault(key, _VerbP95())
     p95 = tracker.observe(dur)
     ctx.error = ctx.error or exc is not None or status >= 500

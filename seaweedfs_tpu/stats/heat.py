@@ -91,7 +91,9 @@ class HeatTracker:
         self.bucket_s = window_s / BUCKETS
         self.needle_sample = max(1, needle_sample)
         self.top_n = max(1, top_n)
-        self._vols: Dict[int, _VolHeat] = {}
+        # lock-free reads are the documented trade (telemetry may lose
+        # the odd increment); every INSERT/DROP takes the lock
+        self._vols: Dict[int, _VolHeat] = {}  # guarded_by(self._lock, writes)
         self._lock = threading.Lock()   # vid insert + gauge child reg only
         _TRACKERS.add(self)
 

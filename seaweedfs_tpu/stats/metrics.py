@@ -49,7 +49,7 @@ class _Metric:
         self.help = help_text
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[Tuple[str, ...], object] = {}  # guarded_by(self._lock)
 
     def labels(self, *values: str):
         values = tuple(str(v) for v in values)

@@ -77,7 +77,10 @@ class DiskFile(BackendStorageFile):
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         self._fd = os.open(path, flags)
         self._path = path
-        self._size = os.fstat(self._fd).st_size
+        # size() reads lock-free (int load is atomic; a concurrent
+        # extension may be invisible for one call, same as stat racing
+        # a write); extensions/truncates serialize on the lock
+        self._size = os.fstat(self._fd).st_size  # guarded_by(self._size_lock, writes)
         self._size_lock = threading.Lock()
 
     def read_at(self, size: int, offset: int) -> bytes:

@@ -52,7 +52,9 @@ class NeedleMap:
     """Dict-backed needle map bound to an append-only .idx file."""
 
     def __init__(self, index_path: Optional[str] = None):
-        self._map: dict[int, Tuple[int, int]] = {}
+        # point reads (get/len/iter) are GIL-atomic and lock-free on
+        # the serving path; every put/delete takes the lock
+        self._map: dict[int, Tuple[int, int]] = {}  # guarded_by(self._lock, writes)
         self._lock = threading.Lock()
         self.index_path = index_path
         self._index_file = None
@@ -85,6 +87,7 @@ class NeedleMap:
         last_idx = len(keys) - 1 - first_of_reversed
         live = last_idx[sizes[last_idx] >= 0]
         for i in live:
+            # lint: guard-ok(_load runs from __init__ only, before the map is published)
             self._map[int(keys[i])] = (int(offsets[i]), int(sizes[i]))
         self.deleted_count = self.file_count - len(live)
         self.deleted_size = self.content_size - int(sizes[live].sum())
@@ -233,8 +236,9 @@ class KvNeedleMap(NeedleMap):
         self.content_size = 0
         self.deleted_size = 0
         self.max_key = 0
-        self._live_count = 0
-        self._idx_entries = 0      # total .idx entries (durable + buffered)
+        self._live_count = 0  # guarded_by(self._lock, writes)
+        # total .idx entries (durable + buffered)
+        self._idx_entries = 0  # guarded_by(self._lock, writes)
         self._load_stats(index_path)
         self._index_file = open(index_path, "ab")
 
@@ -286,11 +290,13 @@ class KvNeedleMap(NeedleMap):
             else:
                 self._kv.put(self._key(key),
                              self.ENTRY.pack(0, t.TOMBSTONE_SIZE, i + 1))
+        # lint: guard-ok(_load_stats runs from __init__ only, pre-publication)
         self._idx_entries = n_idx
         puts = sizes >= 0
         self.file_count = int(puts.sum())
         self.content_size = int(sizes[puts].sum())
         self.max_key = int(arr["key"].max())
+        # lint: guard-ok(_load_stats runs from __init__ only, pre-publication)
         self._live_count = live
         self.deleted_count = self.file_count - live
         self.deleted_size = self.content_size - live_size

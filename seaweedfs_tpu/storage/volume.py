@@ -115,7 +115,10 @@ class _GroupCommitWriter:
 
     def __init__(self, volume: "Volume"):
         self.volume = volume
-        self._queue: collections.deque[_WriteRequest] = collections.deque()
+        # backlog() peeks lock-free (deque len is GIL-atomic; the
+        # worker-routing heuristic tolerates staleness); stop()'s
+        # post-join drain runs after the writer thread exited
+        self._queue: collections.deque[_WriteRequest] = collections.deque()  # guarded_by(self._cond, writes)
         self._cond = threading.Condition()
         self._stopped = False
         # lint: gate-ok(constructed lazily by _get_writer on the first async write) # lint: thread-ok(group-commit writer; requests rendezvous on futures at the submit seam)
@@ -142,6 +145,7 @@ class _GroupCommitWriter:
         self._thread.join(timeout=10)
         # fail anything still queued
         while self._queue:
+            # lint: guard-ok(post-join drain: the writer thread has exited and submit refuses once stopped)
             self._queue.popleft().complete(
                 error=VolumeError("volume closed"))
 
@@ -189,7 +193,9 @@ class Volume:
         self.last_modified_ts = 0
         self._lock = threading.RLock()
         self.async_write = async_write
-        self._writer: Optional[_GroupCommitWriter] = None
+        # _use_worker's routing peek is lock-free (a stale writer only
+        # mis-routes one request to the inline path, which is valid)
+        self._writer: Optional[_GroupCommitWriter] = None  # guarded_by(self._writer_lock, writes)
         self._writer_lock = threading.Lock()
         base = self.file_name()
         self.dat_path = base + ".dat"

@@ -62,7 +62,7 @@ class SnowflakeSequencer:
         self._last_ms = 0
         self._counter = -1
 
-    def _advance_ms(self) -> None:
+    def _advance_ms(self) -> None:  # requires(self._lock)
         import time
         now_ms = int(time.time() * 1000) - self.EPOCH_MS
         # logical advance: reserving a near-future millisecond block is
@@ -122,7 +122,7 @@ class EtcdSequencer:
         self._next = 0   # next id to hand out locally
         self._ceiling = 0  # end (exclusive) of the claimed range
 
-    def _claim(self, at_least: int) -> None:
+    def _claim(self, at_least: int) -> None:  # requires(self._lock)
         """CAS-advance the shared counter until a batch is claimed."""
         while True:
             cur = self.client.get(self.KEY)

@@ -72,7 +72,9 @@ class Topology:
         self.layouts: Dict[Tuple[str, int, str], VolumeLayout] = {}
         self.ec_locations: Dict[int, Dict[str, ShardBits]] = {}  # vid -> url -> bits
         self.ec_collections: Dict[int, str] = {}
-        self._nodes: Dict[str, DataNode] = {}  # url -> node
+        # url -> node; membership changes take the lock, point reads
+        # (nodes()/find_node snapshots) are GIL-atomic and may be stale
+        self._nodes: Dict[str, DataNode] = {}  # guarded_by(self._lock, writes)
         self._lock = threading.RLock()
         self.next_volume_id = 1
         # subscribers to volume location deltas (KeepConnected analog)

@@ -21,13 +21,13 @@ class VolumeLayout:
         self.replica_count = max(1, replica_count)
         self.ttl = ttl
         self.volume_size_limit = volume_size_limit
-        self.locations: Dict[int, List[DataNode]] = {}
-        self.writable: set[int] = set()
-        self.oversized: set[int] = set()
+        self.locations: Dict[int, List[DataNode]] = {}  # guarded_by(self._lock)
+        self.writable: set[int] = set()  # guarded_by(self._lock)
+        self.oversized: set[int] = set()  # guarded_by(self._lock)
         # vid -> node urls whose replica reports read-only (a vid is
         # readonly while ANY replica is; tracked per-node so a flip back
         # to writable on re-heartbeat clears correctly)
-        self.readonly_on: Dict[int, set] = {}
+        self.readonly_on: Dict[int, set] = {}  # guarded_by(self._lock)
         self._lock = threading.RLock()
 
     def register(self, info: VolumeInfo, dn: DataNode) -> None:
@@ -62,7 +62,7 @@ class VolumeLayout:
             else:
                 self._recheck(vid)
 
-    def _recheck(self, vid: int) -> None:
+    def _recheck(self, vid: int) -> None:  # requires(self._lock)
         ok = (len(self.locations.get(vid, [])) >= self.replica_count
               and not self.readonly_on.get(vid)
               and vid not in self.oversized)

@@ -47,8 +47,8 @@ class DiskTier:
         self.limit = limit_bytes
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
-        self._lru: OrderedDict[str, int] = OrderedDict()
-        self._bytes = 0
+        self._lru: OrderedDict[str, int] = OrderedDict()  # guarded_by(self._lock)
+        self._bytes = 0  # guarded_by(self._lock)
         for name in os.listdir(directory):
             p = os.path.join(directory, name)
             if os.path.isfile(p):

@@ -62,8 +62,9 @@ class FanOutPool:
         self._inflight_gauge = inflight_gauge
         self._q: "queue.SimpleQueue" = queue.SimpleQueue()
         self._lock = threading.Lock()
-        self._threads: List[threading.Thread] = []
-        self._stopping = False
+        # thread_count() reads lock-free (introspection may be stale)
+        self._threads: List[threading.Thread] = []  # guarded_by(self._lock, writes)
+        self._stopping = False  # guarded_by(self._lock)
 
     def thread_count(self) -> int:
         return len(self._threads)

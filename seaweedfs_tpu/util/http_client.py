@@ -33,7 +33,7 @@ from seaweedfs_tpu.stats import cluster_trace as _ctrace
 from seaweedfs_tpu.util.http_server import HeaderDict, parse_header_block
 
 _pool_lock = threading.Lock()
-_pool: Dict[str, List["_Conn"]] = {}
+_pool: Dict[str, List["_Conn"]] = {}  # guarded_by(_pool_lock)
 _MAX_IDLE_PER_HOST = 32
 # Idle-age cap: a pooled socket untouched this long is closed instead
 # of reused. Long-idle sockets are the ones the server side reaps

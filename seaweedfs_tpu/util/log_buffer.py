@@ -67,8 +67,7 @@ class LogBuffer:
         # zero-threads-until-first-use house rule, `gate` check)
         self._flusher: Optional[threading.Thread] = None
 
-    def _ensure_flusher(self) -> None:
-        # caller holds self._lock
+    def _ensure_flusher(self) -> None:  # requires(self._lock)
         if self._flusher is None and not self._stopping:
             # lint: thread-ok(periodic flush daemon owns no request context)
             self._flusher = threading.Thread(
@@ -93,7 +92,7 @@ class LogBuffer:
             self.notify_fn()
         return ts
 
-    def _flush_locked(self) -> None:
+    def _flush_locked(self) -> None:  # requires(self._lock)
         if not self._entries:
             return
         batch = self._entries

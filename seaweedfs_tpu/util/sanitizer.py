@@ -195,7 +195,7 @@ class _SanLockBase:
         for f in new_findings:   # file I/O + metrics OUTSIDE the lock
             _publish(f)
 
-    def _cycle_check(self, frm: int, to: int) -> Optional[dict]:
+    def _cycle_check(self, frm: int, to: int) -> Optional[dict]:  # requires(_graph_lock)
         # caller holds _graph_lock: is there now a path to -> ... -> frm?
         # (we just added frm -> to; a path back closes the cycle).
         # Returns the finding — the caller records it under the lock
@@ -223,7 +223,7 @@ class _SanLockBase:
         }
 
     @staticmethod
-    def _find_path(frm: int, to: int) -> Optional[List[int]]:
+    def _find_path(frm: int, to: int) -> Optional[List[int]]:  # requires(_graph_lock)
         # iterative DFS over _adj; returns the node list frm..to
         seen = {frm}
         stack = [(frm, [frm])]

@@ -69,6 +69,7 @@ def _ensure_configured() -> None:
     # Auto-configure only when nobody else set up logging: a host app
     # that installed its own handlers (on our logger or the root) keeps
     # control — we never clobber it from an import side effect.
+    # lint: guard-ok(double-checked fast path; a stale False only repeats the idempotent configure)
     if _configured:
         return
     if logging.getLogger(_ROOT_NAME).handlers or logging.getLogger().handlers:

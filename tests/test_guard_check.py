@@ -1,0 +1,378 @@
+"""Guarded-by thread-safety analysis (ISSUE 10): the `guard` check.
+
+Synthetic-package fixtures proving each leg of the contract — guard
+inference from locked writes, explicit `# guarded_by` annotations
+(strict and `writes` mode), the `# requires(<lock>)` helper claim,
+the module-level variant, the `__init__`/property exemptions, and the
+pragma/stale-pragma discipline the rest of the analyzer already
+enforces. The tree-wide zero-findings headline lives in
+test_static_analysis.py (the `guard` check registers with the same
+engine and runs there too).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from seaweedfs_tpu.analysis.engine import run_checks
+
+
+def _analyze(tmp_path, source, checks=("guard",)):
+    (tmp_path / "m.py").write_text(textwrap.dedent(source))
+    return run_checks(root=tmp_path,
+                      checks=list(checks) if checks else None)
+
+
+# -- inference ----------------------------------------------------------------
+
+
+def test_inferred_guard_flags_cross_method_access(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+            def peek(self):
+                return self._n
+        """)
+    assert len(fs) == 1
+    assert "'_n' is mutated under self._lock" in fs[0].message
+    assert "peek()" in fs[0].message
+
+
+def test_inferred_guard_flags_unlocked_cross_method_write(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+            def reset(self):
+                self._items = []
+        """)
+    assert len(fs) == 1 and "reset()" in fs[0].message
+    assert "write" in fs[0].message
+
+
+def test_inference_skips_same_method_and_locked_access(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def bump(self):
+                tmp = self._n        # same method as the locked write
+                with self._lock:
+                    self._n = tmp + 1
+            def locked_peek(self):
+                with self._lock:
+                    return self._n   # holds the lock
+        """)
+    assert not fs
+
+
+def test_inference_ignores_non_lock_with_items(tmp_path):
+    fs = _analyze(tmp_path, """\
+        class C:
+            def __init__(self):
+                self._f = open("/dev/null")
+                self._n = 0
+            def a(self):
+                with self._f:
+                    self._n = 1
+            def b(self):
+                return self._n
+        """)
+    assert not fs
+
+
+# -- annotations --------------------------------------------------------------
+
+
+def test_annotated_guard_enforced_everywhere(tmp_path):
+    # annotation (unlike inference) also catches same-method slips
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by(self._lock)
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+                self._n = 0          # same method, still a violation
+        """)
+    assert len(fs) == 1
+    assert "guarded_by(self._lock)" in fs[0].message
+
+
+def test_writes_mode_sanctions_lock_free_reads(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._m = {}  # guarded_by(self._lock, writes)
+            def put(self, k, v):
+                with self._lock:
+                    self._m[k] = v
+            def get(self, k):
+                return self._m.get(k)    # sanctioned
+            def bad_drop(self, k):
+                self._m.pop(k, None)     # mutation: still flagged
+        """)
+    assert len(fs) == 1 and "bad_drop" in fs[0].message
+
+
+def test_annotation_on_comment_line_above(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded_by(self._lock)
+                self._n = 0
+            def peek(self):
+                return self._n
+        """)
+    assert len(fs) == 1 and "guarded_by" in fs[0].message
+
+
+def test_requires_treats_body_as_locked(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by(self._lock)
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+            def _bump_locked(self):  # requires(self._lock)
+                self._n += 1
+        """)
+    assert not fs
+
+
+def test_unbound_annotations_are_findings(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        # guarded_by(self._lock)
+        def f():
+            pass
+        def g():  # requires(_lock)
+            pass
+        x = 1  # requires(_lock)
+        """)
+    msgs = " | ".join(f.message for f in fs)
+    assert "not attached to an assignment" in msgs
+    assert "not attached to a def" in msgs
+
+
+def test_conflicting_annotations_are_findings(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._n = 0  # guarded_by(self._a)
+            def reset(self):
+                self._n = 1  # guarded_by(self._b)
+        """)
+    assert any("conflicting guarded_by" in f.message for f in fs)
+
+
+# -- module-level variant -----------------------------------------------------
+
+
+def test_module_level_lock_inference(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+        _registry = {}
+        def register(k, v):
+            with _lock:
+                _registry[k] = v
+        def drop(k):
+            _registry.pop(k, None)
+        """)
+    assert len(fs) == 1 and "drop()" in fs[0].message
+
+
+def test_module_level_annotation_and_locals_shadowing(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+        _reg = {}  # guarded_by(_lock)
+        def ok(k):
+            with _lock:
+                _reg[k] = 1
+        def shadowed():
+            _reg = {}        # local, not the module global
+            _reg["x"] = 1
+        def bad(k):
+            _reg[k] = 2
+        """)
+    assert len(fs) == 1 and "bad()" in fs[0].message
+
+
+def test_module_toplevel_code_is_exempt(tmp_path):
+    # imports run single-threaded: module-scope writes are fine
+    fs = _analyze(tmp_path, """\
+        import threading
+        _lock = threading.Lock()
+        _reg = {}  # guarded_by(_lock)
+        _reg["boot"] = 1
+        def ok(k):
+            with _lock:
+                _reg[k] = 1
+        """)
+    assert not fs
+
+
+# -- exemptions ---------------------------------------------------------------
+
+
+def test_init_and_property_are_exempt(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by(self._lock)
+                self._n = 1          # __init__: pre-publication
+            @property
+            def n(self):
+                return self._n       # property: sanctioned status read
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+        """)
+    assert not fs
+
+
+def test_closure_under_lock_is_not_exempt(tmp_path):
+    # a def inside a locked region runs LATER (usually another thread)
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by(self._lock)
+            def spawn(self):
+                with self._lock:
+                    def later():
+                        return self._n
+                    return later
+        """)
+    assert len(fs) == 1 and "spawn()" in fs[0].message
+
+
+# -- pragma discipline --------------------------------------------------------
+
+
+def test_requires_on_a_methods_last_line_does_not_exempt_the_body(tmp_path):
+    # a stray per-statement requires comment at the method's tail must
+    # not bind to the enclosing def (review finding: end_lineno of a
+    # FunctionDef is its last BODY line)
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0  # guarded_by(self._lock)
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+            def sneaky(self):
+                self._n = 5  # requires(self._lock)
+        """)
+    assert any("does not hold it" in f.message for f in fs), \
+        "tail-line requires must not exempt the method body"
+
+
+def test_inference_accepts_any_common_writer_lock(tmp_path):
+    # writes run under BOTH locks; a read under either member of the
+    # common set is correctly synchronized against every write
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._big_lock = threading.Lock()
+                self._small_lock = threading.Lock()
+                self._n = 0
+            def bump(self):
+                with self._big_lock:
+                    with self._small_lock:
+                        self._n += 1
+            def peek_small(self):
+                with self._small_lock:
+                    return self._n
+            def peek_big(self):
+                with self._big_lock:
+                    return self._n
+            def bad_peek(self):
+                return self._n
+        """)
+    assert len(fs) == 1 and "bad_peek" in fs[0].message
+
+
+def test_with_statement_on_guarded_attr_is_an_access(tmp_path):
+    # entering a context manager reads the attribute: a guarded object
+    # used as `with self._writer:` must honor its own guard
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._wl = threading.Lock()
+                self._writer = object()  # guarded_by(self._wl)
+            def swap(self):
+                with self._wl:
+                    self._writer = object()
+            def use(self):
+                with self._writer:
+                    pass
+        """)
+    assert len(fs) == 1 and "use()" in fs[0].message
+
+
+def test_guard_pragma_suppresses_with_reason(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+            def peek(self):
+                # lint: guard-ok(stats peek; int load is GIL-atomic)
+                return self._n
+        """)
+    assert not fs
+
+
+def test_stale_guard_pragma_is_flagged(tmp_path):
+    fs = _analyze(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+            def bump(self):
+                with self._lock:
+                    # lint: guard-ok(nothing wrong here)
+                    self._n += 1
+        """, checks=None)   # full run: pragma hygiene included
+    assert any(f.check == "pragma" and "stale" in f.message
+               for f in fs)

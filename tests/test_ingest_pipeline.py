@@ -64,20 +64,30 @@ class TestLeaseCache:
         assert all(parse_fid(f).volume_id == 7 for f in fids)
 
     def test_low_water_triggers_async_refill(self):
-        m = FakeMaster()
-        lc = LeaseCache(count=8, low_water=2, assign_fn=m)
-        # cold miss banks 7; five more pops walk depth 6..2 — the pop
-        # that leaves depth==2 crosses the low-water mark
-        for _ in range(6):
-            lc.acquire("m")
-        deadline = time.monotonic() + 5.0
-        while len(m.calls) < 2 and time.monotonic() < deadline:
-            time.sleep(0.01)                  # refill is ASYNC
-        assert len(m.calls) == 2, "no refill below the low-water mark"
-        deadline = time.monotonic() + 5.0
-        while lc.depth() < 10 and time.monotonic() < deadline:
-            time.sleep(0.01)
-        assert lc.depth() == 10, "refill never banked its batch"
+        # explorer-driven (ISSUE 10): the refill thread joins the
+        # cooperative schedule, so "refill is ASYNC" stops being a
+        # wall-clock poll loop (sleep(0.01) × deadline, the flaky-CI
+        # shape) and becomes 20 deterministic interleavings of the
+        # acquire stream against the banking thread
+        from seaweedfs_tpu.util.scheduler import explore
+
+        def scenario():
+            m = FakeMaster()
+            lc = LeaseCache(count=8, low_water=2, assign_fn=m)
+            # cold miss banks 7; five more pops walk depth 6..2 — the
+            # pop that leaves depth==2 crosses the low-water mark
+            for _ in range(6):
+                lc.acquire("m")
+            # virtual time: each sleep is a scheduling point handing
+            # the refill thread the token, never a real wait
+            while lc.depth() < 10:
+                time.sleep(0)
+            assert len(m.calls) == 2, \
+                "refill must cost exactly one more assign round trip"
+            assert lc.depth() == 10, "refill never banked its batch"
+
+        res = explore(scenario, schedules=20, seed=0, check=False)
+        assert not res.failures, res.failures[0]
 
     def test_expired_leases_never_handed_out(self):
         m = FakeMaster()

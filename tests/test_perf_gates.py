@@ -789,3 +789,63 @@ def test_sanitizer_disabled_overhead():
         sanitizer.reset()
     assert threading.Lock is sanitizer._ORIG_LOCK
     assert threading.RLock is sanitizer._ORIG_RLOCK
+
+
+def test_scheduler_disabled_overhead():
+    """The schedule explorer (ISSUE 10) must be STRICTLY zero-cost
+    unarmed, same contract as the sanitizer gate above: importing the
+    module leaves `threading.Lock` as the untouched C factory, patches
+    nothing in `queue`/`time`, and spawns zero import-time threads.
+    Arming is reversible, and an explore() run restores whatever
+    factories it found (sanitizer composition included)."""
+    import queue as queue_mod
+    import threading
+    import time as time_mod
+
+    from seaweedfs_tpu.util import sanitizer
+    from seaweedfs_tpu.util import scheduler
+
+    if os.environ.get("SEAWEED_SCHED"):
+        pytest.skip("suite runs armed by explicit request")
+    assert not scheduler.armed(), \
+        "scheduler must be unarmed without SEAWEED_SCHED"
+    assert threading.Lock is sanitizer._ORIG_LOCK, \
+        "unarmed scheduler must leave threading.Lock untouched"
+    assert threading.RLock is sanitizer._ORIG_RLOCK
+    assert threading.Event.__module__ == "threading"
+    assert threading.Thread.__module__ == "threading"
+    assert queue_mod.SimpleQueue.__module__ == "_queue"
+    assert queue_mod.Queue.__module__ == "queue"
+    assert time_mod.sleep.__module__ is None or \
+        "scheduler" not in str(time_mod.sleep.__module__)
+
+    # zero import-time threads: the module is imported (above) and the
+    # process thread set contains no scheduler-born thread
+    assert not [t for t in threading.enumerate()
+                if "sched" in t.name.lower()]
+
+    # the unarmed lock cycle is the stock C path (same bound as the
+    # sanitizer gate)
+    lk = threading.Lock()
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        with lk:
+            pass
+    stock = (time.perf_counter() - t0) / 200_000
+    assert stock < 2e-6, f"stock lock cycle {stock * 1e6:.3f} us?!"
+
+    # arm/disarm restores the zero-cost state exactly, and a wrapper
+    # created while armed keeps delegating afterwards
+    scheduler.arm()
+    try:
+        assert scheduler.armed()
+        assert threading.Lock is not sanitizer._ORIG_LOCK
+        leftover = threading.Lock()
+    finally:
+        scheduler.disarm()
+    assert threading.Lock is sanitizer._ORIG_LOCK
+    assert threading.RLock is sanitizer._ORIG_RLOCK
+    assert queue_mod.SimpleQueue.__module__ == "_queue"
+    with leftover:            # delegate mode: plain real lock
+        assert leftover.locked()
+    assert not scheduler.armed()
