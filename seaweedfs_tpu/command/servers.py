@@ -244,6 +244,27 @@ def _volume_parser() -> argparse.ArgumentParser:
                         "via volume.scrub / the master scheduler)")
     p.add_argument("-ec.encoder", dest="ec_encoder", default="auto",
                    choices=["auto", "jax", "native", "numpy", "pallas"])
+    p.add_argument("-ec.mesh", dest="ec_mesh", action="store_true",
+                   default=False,
+                   help="run batched EC encode/verify/decode on the "
+                        "unified pod-scale mesh scheduler (one "
+                        "scheduler feeding all jax devices; falls "
+                        "back per pass to the per-device fleet on "
+                        "any mesh failure)")
+    p.add_argument("-ec.meshMinVolumes", dest="ec_mesh_min_volumes",
+                   type=int, default=0,
+                   help="smallest volume batch worth sharding over "
+                        "the mesh (0 = the mesh's dp axis size)")
+    p.add_argument("-ec.meshBucketMB", dest="ec_mesh_bucket_mb",
+                   type=int, default=32,
+                   help="data bytes per fused [dp, 10, span] mesh "
+                        "bucket upload")
+    p.add_argument("-ec.meshTimeoutS", dest="ec_mesh_timeout_s",
+                   type=float, default=30.0,
+                   help="bucket dispatch stall bound before the pass "
+                        "abandons the mesh and falls back (0 = wait "
+                        "forever; also capped by the request "
+                        "deadline)")
     p.add_argument("-cache.sizeMB", dest="cache_size_mb", type=int,
                    default=0,
                    help="RAM budget for the tiered read cache "
@@ -393,7 +414,11 @@ def _build_volume(opts):
         hedge_reads=opts.resilience_hedge,
         hedge_delay_ms=opts.resilience_hedge_delay_ms,
         heat_track=opts.heat_track,
-        heat_window_s=opts.heat_window_s)
+        heat_window_s=opts.heat_window_s,
+        ec_mesh=opts.ec_mesh,
+        ec_mesh_min_volumes=opts.ec_mesh_min_volumes,
+        ec_mesh_bucket_mb=opts.ec_mesh_bucket_mb,
+        ec_mesh_timeout_s=opts.ec_mesh_timeout_s)
 
 
 @command("volume", "start a volume server (data plane)")

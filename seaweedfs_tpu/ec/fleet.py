@@ -61,8 +61,21 @@ from seaweedfs_tpu.resilience import failpoint as _failpoint
 from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.stats.metrics import (
     FleetDispatchBatchHistogram, FleetDispatchedBytesCounter,
-    FleetReaderQueueGauge, FleetStageSecondsHistogram,
-    FleetWriterBacklogGauge)
+    FleetMeshFallbacksCounter, FleetReaderQueueGauge,
+    FleetStageSecondsHistogram, FleetWriterBacklogGauge)
+
+
+def mesh_fleet_or_none():
+    """The pod-scale mesh scheduler module (parallel/mesh_fleet), or
+    None on a jax-less host — parallel's package import needs jax at
+    import time. A None return counts as a mesh fallback; the caller
+    runs the host fleet path instead."""
+    try:
+        from seaweedfs_tpu.parallel import mesh_fleet
+        return mesh_fleet
+    except ImportError:
+        FleetMeshFallbacksCounter.labels("unavailable").inc()
+        return None
 
 # Reader-pool width: enough to keep several volumes' sequential reads
 # in flight without degrading each stream to fully random IO.
@@ -94,7 +107,7 @@ _LANE_QUEUE = 4
 # unit of work.
 _STAGE_HIST = {s: FleetStageSecondsHistogram.labels(s)
                for s in ("read", "dispatch", "rs", "retire", "write",
-                         "verify")}
+                         "verify", "upload")}
 
 
 class _StageTimer:
@@ -165,27 +178,41 @@ class TaggedPipeline:
         self._retirer.start()
 
     def _put_lane(self, tag: int, fn: Callable[[], None],
-                  token: Optional[int]) -> None:
+                  token: Optional[int],
+                  timeout_s: Optional[float] = None) -> None:
         lane = tag % len(self._lanes)
         # inc/dec deltas, not set(qsize): several schedulers run
         # concurrently (mesh sharding, parallel generate RPCs) and
         # share these children, so the gauge must SUM their backlogs
         # rather than last-write-wins one scheduler's view
         self._lane_gauges[lane].inc()
-        self._lanes[lane].put((fn, token))
+        try:
+            self._lanes[lane].put((fn, token), timeout=timeout_s)
+        except queue.Full:
+            self._lane_gauges[lane].dec()  # never entered the lane
+            raise
 
-    def write(self, tag: int, fn: Callable[[], None]) -> None:
-        """Enqueue one ordered write on `tag`'s lane (no handle)."""
+    def write(self, tag: int, fn: Callable[[], None],
+              timeout_s: Optional[float] = None) -> None:
+        """Enqueue one ordered write on `tag`'s lane (no handle).
+        With timeout_s, a lane that stays full that long raises
+        queue.Full instead of blocking the caller behind a wedged
+        writer — same stall contract as submit()."""
         self._raise_pending()
-        self._put_lane(tag, fn, trace.handoff())
+        self._put_lane(tag, fn, trace.handoff(), timeout_s)
 
     def submit(self, handle,
-               tagged: Sequence[Tuple[int, Callable]]) -> None:
+               tagged: Sequence[Tuple[int, Callable]],
+               timeout_s: Optional[float] = None) -> None:
         """Queue a dispatch: when `handle` resolves (FIFO), span i's
         output goes to `tagged[i] = (tag, fn)` as `fn(outs[i])` on
-        tag's lane."""
+        tag's lane. With timeout_s, waiting `timeout_s` for a free
+        in-flight slot raises queue.Full — the mesh scheduler's
+        dispatch-stall detection (parallel/mesh_fleet.py) — instead of
+        blocking forever behind a wedged retire."""
         self._raise_pending()
-        self._retireq.put((handle, list(tagged), trace.handoff()))
+        self._retireq.put((handle, list(tagged), trace.handoff()),
+                          timeout=timeout_s)
 
     def _retire_loop(self) -> None:
         while True:
@@ -193,7 +220,17 @@ class TaggedPipeline:
             if item is None:
                 return
             if self._exc is not None:
-                continue  # failed: keep draining, write nothing more
+                # failed: keep draining, write nothing more — but let
+                # the handle release its resources (the mesh scheduler
+                # tracks in-flight buckets per handle)
+                abandon = getattr(item[0], "abandon", None)
+                if abandon is not None:
+                    try:
+                        abandon()
+                    # lint: swallow-ok(first error already latched; abandon is cleanup)
+                    except Exception:
+                        pass
+                continue
             handle, tagged, token = item
             try:
                 # the retire stage is where async dispatches actually

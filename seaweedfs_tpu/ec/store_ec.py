@@ -75,13 +75,20 @@ def generate_ec_shards(store: Store, vid: int, backend: str = "auto") -> str:
 
 
 def generate_ec_shards_batch(store: Store, vids: Sequence[int],
-                             backend: str = "auto") -> Dict[int, str]:
+                             backend: str = "auto",
+                             mesh_cfg: Optional[dict] = None
+                             ) -> Dict[int, str]:
     """VolumeEcShardsGenerate for MANY volumes in one fused pass.
 
-    Every volume is frozen (read-only + sync) up front, then a single
-    fleet scheduler (ec/fleet.py) packs chunks from all of them into
-    shared RS dispatches. Shard bytes are identical to calling
-    generate_ec_shards per volume. Returns {vid: base_name}.
+    Every volume is frozen (read-only + sync) up front, then ONE
+    scheduler packs chunks from all of them into shared RS dispatches:
+    with `mesh_cfg` (the volume server's -ec.mesh* knobs) the pass
+    rides the unified pod-scale mesh scheduler
+    (parallel/mesh_fleet.pod_write_ec_files, which falls back to the
+    per-device fleet ladder on any MeshError); without it, the host
+    fleet scheduler (ec/fleet.py). Shard bytes are identical to
+    calling generate_ec_shards per volume either way. Returns
+    {vid: base_name}.
     """
     vols = []
     for vid in vids:  # validate the whole list BEFORE freezing any —
@@ -95,7 +102,14 @@ def generate_ec_shards_batch(store: Store, vids: Sequence[int],
         v.sync()
         bases[vid] = v.file_name()
     with trace.span("store_ec.generate_batch", volumes=len(bases)):
-        fleet.fleet_write_ec_files(list(bases.values()), backend=backend)
+        mesh_fleet = fleet.mesh_fleet_or_none() \
+            if mesh_cfg is not None else None
+        if mesh_fleet is not None:
+            mesh_fleet.pod_write_ec_files(list(bases.values()),
+                                          backend=backend, **mesh_cfg)
+        else:
+            fleet.fleet_write_ec_files(list(bases.values()),
+                                       backend=backend)
         with trace.span("store_ec.write_ecx"):
             for base in bases.values():
                 encoder.write_sorted_file_from_idx(base)

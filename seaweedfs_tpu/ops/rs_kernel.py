@@ -72,6 +72,26 @@ def gf_linear(m2: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
     return bits_pack(out_bits)
 
 
+def gf_linear_gemm(m2: jnp.ndarray, shards: jnp.ndarray) -> jnp.ndarray:
+    """`gf_linear` with the GF(2) contraction run as a float32 GEMM.
+
+    Exact, not approximate: every bit-plane dot product sums at most
+    S*8 <= 112 ones (RS(10,4) maps), far inside float32's exact-integer
+    range, so truncating the accumulator to int32 parity reproduces the
+    int32 einsum bit for bit. XLA's CPU backend tiles f32 GEMMs far
+    better than int8/int32 einsums (~1.4x measured on the forced
+    8-device rig); the pod-scale mesh data plane
+    (parallel/mesh_fleet.py) runs its per-device blocks through this
+    entry. The host fleet/serial dispatches keep the int path — their
+    slab shapes are tuned around it (migrating them is a ROADMAP
+    follow-up, gated on re-baselining BENCH.md).
+    """
+    in_bits = bits_expand(shards).astype(jnp.float32)
+    acc = jnp.einsum("os,...sn->...on", m2.astype(jnp.float32), in_bits)
+    out_bits = (acc.astype(jnp.int32) & jnp.int32(1)).astype(jnp.uint8)
+    return bits_pack(out_bits)
+
+
 @functools.partial(jax.jit, static_argnames=())
 def _gf_linear_jit(m2, shards):
     return gf_linear(m2, shards)

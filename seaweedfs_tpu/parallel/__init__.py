@@ -29,7 +29,24 @@ from seaweedfs_tpu.parallel.mesh import (
     round_robin_by_size,
     fleet_write_ec_files_sharded,
 )
+from seaweedfs_tpu.parallel.mesh_fleet import (
+    MeshError,
+    MeshDispatchTimeout,
+    MeshUnavailable,
+    MeshVerifyMismatch,
+    mesh_write_ec_files,
+    mesh_verify_ec_files,
+    mesh_rebuild_ec_files,
+    pod_write_ec_files,
+    pod_verify_ec_files,
+    sharded_reconstruct,
+)
 
 __all__ = ["make_mesh", "sharded_encode", "sharded_write_ec_files",
            "ec_pipeline_step", "rotate_shards", "volume_shard_matrix",
-           "round_robin_by_size", "fleet_write_ec_files_sharded"]
+           "round_robin_by_size", "fleet_write_ec_files_sharded",
+           "MeshError", "MeshDispatchTimeout", "MeshUnavailable",
+           "MeshVerifyMismatch", "mesh_write_ec_files",
+           "mesh_verify_ec_files", "mesh_rebuild_ec_files",
+           "pod_write_ec_files", "pod_verify_ec_files",
+           "sharded_reconstruct"]

@@ -48,8 +48,8 @@ from seaweedfs_tpu.ops.rs_code import DATA_SHARDS, TOTAL_SHARDS, ReedSolomon
 from seaweedfs_tpu.resilience import deadline as deadline_mod
 from seaweedfs_tpu.stats import trace
 from seaweedfs_tpu.stats.metrics import (
-    ReadsDecodedBytesCounter, ReadsDegradedBatchHistogram,
-    ReadsDegradedCounter)
+    FleetMeshFallbacksCounter, ReadsDecodedBytesCounter,
+    ReadsDegradedBatchHistogram, ReadsDegradedCounter)
 from seaweedfs_tpu.util import wlog
 
 log = wlog.logger("reads")
@@ -126,15 +126,18 @@ class DegradedReadFleet:
     def __init__(self, backend: str = "auto",
                  batch_window_s: float = BATCH_WINDOW_S,
                  max_batch: int = MAX_BATCH,
-                 readers: int = FLEET_READERS):
+                 readers: int = FLEET_READERS,
+                 use_mesh: bool = False):
         self.backend = backend
         self.batch_window_s = batch_window_s
         self.max_batch = max(1, max_batch)
         self.readers = max(1, readers)
+        self.use_mesh = use_mesh
         # written once inside _ensure_started's locked section before
         # the dispatcher spawns (happens-before via Thread.start), so
         # worker-side reads are lock-free by design
         self._rs: Optional[ReedSolomon] = None  # guarded_by(self._start_lock, writes)
+        self._mesh = None  # guarded_by(self._start_lock, writes)
         self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._start_lock = threading.Lock()
         self._dispatcher: Optional[threading.Thread] = None  # guarded_by(self._start_lock, writes)
@@ -155,6 +158,18 @@ class DegradedReadFleet:
             if self._dispatcher is not None or self._stopping:
                 return
             self._rs = ReedSolomon(backend=self.backend)
+            if self.use_mesh:
+                # -ec.mesh: fused decode dispatches ride the pod-scale
+                # sharded reconstruct. Resolved ONCE here (first
+                # degraded read): a single-device host simply keeps
+                # the per-batch host dispatch, no per-request probing.
+                from seaweedfs_tpu.ec.fleet import mesh_fleet_or_none
+                mesh_fleet = mesh_fleet_or_none()
+                if mesh_fleet is not None:
+                    try:
+                        self._mesh = mesh_fleet._resolve_mesh(None)
+                    except mesh_fleet.MeshError:
+                        self._mesh = None
             # lint: thread-ok(decode fleet pool; decode enforces the deadline on the caller thread)
             self._pool = ThreadPoolExecutor(
                 max_workers=self.readers,
@@ -440,8 +455,25 @@ class DegradedReadFleet:
                             span=span) if trace.is_enabled() else trace.NOOP
             try:
                 with sp:
-                    out = self._rs.reconstruct_some(
-                        list(present), [missing], src)  # [B, 1, span]
+                    out = None
+                    if self._mesh is not None and len(members) >= 2:
+                        from seaweedfs_tpu.parallel import mesh_fleet
+                        try:
+                            out = mesh_fleet.sharded_reconstruct(
+                                self._mesh, list(present), [missing],
+                                src)
+                        except Exception as e:
+                            # demote to the host dispatch; the request
+                            # must not fail on a mesh-only error
+                            FleetMeshFallbacksCounter.labels(
+                                "error").inc()
+                            log.warning(
+                                "mesh decode fell back (%r); "
+                                "re-solving on the host path", e)
+                            out = None
+                    if out is None:
+                        out = self._rs.reconstruct_some(
+                            list(present), [missing], src)  # [B, 1, span]
             except BaseException as e:  # noqa: BLE001 - latch per group
                 for r in members:
                     r.error = e

@@ -72,12 +72,17 @@ class ScrubDaemon:
                  backend: str = "auto", interval_s: float = 0.0,
                  replica_fetch: Optional[Callable] = None,
                  export_lag: bool = True,
-                 on_repair: Optional[Callable[[int], None]] = None):
+                 on_repair: Optional[Callable[[int], None]] = None,
+                 mesh_cfg: Optional[dict] = None):
         self.store = store
         self.mbps = mbps
         self.backend = backend
         self.interval_s = interval_s
         self.replica_fetch = replica_fetch
+        # -ec.mesh* knobs: when set, the fused stripe verify rides the
+        # unified pod-scale scheduler (parallel/mesh_fleet), falling
+        # back to the host fleet verifier on any MeshError
+        self.mesh_cfg = mesh_cfg
         # on_repair(vid) fires after scrub rewrites any bytes of a
         # volume (needle rewrite or EC shard reconstruction) — the
         # volume server hangs read-cache invalidation here so a repair
@@ -321,8 +326,16 @@ class ScrubDaemon:
         self._checkpoint(0)
         by_base = {ecv.base_name: (vid, ecv) for vid, ecv in ecvs}
         with trace.span("scrub.verify", volumes=len(by_base)):
-            verified = fleet.fleet_verify_ec_files(
-                list(by_base), backend=self.backend, throttler=throttler)
+            mesh_fleet = fleet.mesh_fleet_or_none() \
+                if self.mesh_cfg is not None else None
+            if mesh_fleet is not None:
+                verified = mesh_fleet.pod_verify_ec_files(
+                    list(by_base), backend=self.backend,
+                    throttler=throttler, **self.mesh_cfg)
+            else:
+                verified = fleet.fleet_verify_ec_files(
+                    list(by_base), backend=self.backend,
+                    throttler=throttler)
         for base, vr in verified.items():
             vid, ecv = by_base[base]
             d = damages[vid]

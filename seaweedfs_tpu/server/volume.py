@@ -87,7 +87,11 @@ class VolumeServer:
                  hedge_reads: bool = False,
                  hedge_delay_ms: float = 10.0,
                  heat_track: bool = False,
-                 heat_window_s: float = 60.0):
+                 heat_window_s: float = 60.0,
+                 ec_mesh: bool = False,
+                 ec_mesh_min_volumes: int = 0,
+                 ec_mesh_bucket_mb: int = 32,
+                 ec_mesh_timeout_s: float = 30.0):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -104,6 +108,18 @@ class VolumeServer:
         self.rack = rack
         self.pulse_seconds = pulse_seconds
         self.ec_encoder = ec_encoder
+        # -ec.mesh* knobs for the unified pod-scale scheduler
+        # (parallel/mesh_fleet). None — not merely empty — when
+        # disabled, so the default path never imports the mesh module
+        # or queries jax devices
+        # (test_perf_gates.test_mesh_disabled_overhead)
+        self.ec_mesh_cfg = None
+        if ec_mesh:
+            self.ec_mesh_cfg = {
+                "min_volumes": ec_mesh_min_volumes,
+                "bucket_mb": ec_mesh_bucket_mb,
+                "timeout_s": ec_mesh_timeout_s,
+            }
         self.compaction_mbps = compaction_mbps
         self.store = Store(directories, max_volume_counts, ip=ip, port=port,
                            public_url=public_url,
@@ -127,7 +143,8 @@ class VolumeServer:
             from seaweedfs_tpu.reads import DegradedReadFleet
             self.degraded = DegradedReadFleet(
                 backend=ec_encoder,
-                batch_window_s=degraded_batch_ms / 1000.0)
+                batch_window_s=degraded_batch_ms / 1000.0,
+                use_mesh=ec_mesh)
         # background integrity scrub: costs nothing (no thread, no IO)
         # until started — by RPC, by the master's staggered scheduler,
         # or at boot when -scrub.intervalSeconds is set
@@ -135,7 +152,8 @@ class VolumeServer:
             self.store, mbps=scrub_mbps, backend=ec_encoder,
             interval_s=scrub_interval_s,
             replica_fetch=self._fetch_needle_from_replica,
-            on_repair=self._invalidate_volume_cache)
+            on_repair=self._invalidate_volume_cache,
+            mesh_cfg=self.ec_mesh_cfg)
         self.scrub_interval_s = scrub_interval_s
         self.volume_size_limit = 30 << 30
         self.compact_states: Dict[int, vacuum_mod.CompactState] = {}
@@ -760,11 +778,14 @@ class VolumeServer:
                     self.store, vids[0],
                     backend=request.encoder or self.ec_encoder)
             else:
-                # cross-volume fused encode: one fleet scheduler packs
-                # all the volumes' chunks into shared RS dispatches
+                # cross-volume fused encode: one scheduler packs all
+                # the volumes' chunks into shared RS dispatches — the
+                # pod-scale mesh scheduler under -ec.mesh, the host
+                # fleet otherwise
                 store_ec.generate_ec_shards_batch(
                     self.store, vids,
-                    backend=request.encoder or self.ec_encoder)
+                    backend=request.encoder or self.ec_encoder,
+                    mesh_cfg=self.ec_mesh_cfg)
         except NeedleError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         for vid in vids:

@@ -324,6 +324,21 @@ FleetWriterBacklogGauge = REGISTRY.gauge(
     "SeaweedFS_fleet_writer_lane_backlog",
     "writes queued on one writer lane", ("lane",))
 
+# Unified mesh scheduler families (parallel/mesh_fleet.py): the
+# pod-scale data plane's bucket stream. `op` is the dispatch kind
+# (encode | verify | rebuild); fallback `reason` is bounded
+# (unavailable | timeout | error).
+FleetMeshBucketsCounter = REGISTRY.counter(
+    "SeaweedFS_fleet_mesh_buckets_total",
+    "fixed-shape sharded buckets dispatched over the mesh", ("op",))
+FleetMeshInflightGauge = REGISTRY.gauge(
+    "SeaweedFS_fleet_mesh_inflight_buckets",
+    "mesh buckets uploaded/computing, not yet retired")
+FleetMeshFallbacksCounter = REGISTRY.counter(
+    "SeaweedFS_fleet_mesh_fallbacks_total",
+    "pod passes demoted to the per-device fleet schedulers",
+    ("reason",))
+
 # Scrub families (seaweedfs_tpu/scrub/): the background integrity
 # subsystem's ledger. `kind` distinguishes what was damaged: a needle
 # in a normal volume ("needle"), an EC data shard ("ec_data"), an EC

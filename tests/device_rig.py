@@ -1,0 +1,51 @@
+"""Subprocess multi-device CPU rig (ISSUE 11 satellite).
+
+The main test process is pinned to ONE virtual device count for its
+whole life (conftest's force_cpu_platform(8) — XLA reads
+``--xla_force_host_platform_device_count`` exactly once, at backend
+init). Mesh-factoring and scheduler behavior at OTHER device counts
+(a 6-chip pod, a 3-host CPU rig, the single-device fallback ladder)
+therefore needs a fresh interpreter per count: this helper spawns one,
+forced onto an n-device virtual CPU platform, and asserts the body
+runs clean.
+
+The body is plain python source; keep it self-contained (its only
+ambient guarantee is the repo on sys.path and the forced platform).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROLOG = """\
+from seaweedfs_tpu.util.cpu_mesh import force_cpu_platform
+force_cpu_platform({n})
+"""
+
+
+def run_under_devices(n_devices: int, body: str,
+                      timeout: float = 300.0) -> str:
+    """Run `body` in a fresh interpreter on an n-device virtual CPU
+    platform; returns its stdout, fails the calling test on a non-zero
+    exit (with both streams in the message)."""
+    src = _PROLOG.format(n=n_devices) + textwrap.dedent(body)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={n_devices}",
+    )
+    # the rig's subprocesses must not inherit an armed cooperative
+    # scheduler or sanitizer from an outer test environment
+    for k in ("SEAWEED_SCHED", "SEAWEED_SANITIZE"):
+        env.pop(k, None)
+    r = subprocess.run([sys.executable, "-c", src], cwd=REPO_ROOT,
+                       env=env, capture_output=True, text=True,
+                       timeout=timeout)
+    assert r.returncode == 0, (
+        f"subprocess under {n_devices} devices exited "
+        f"{r.returncode}\n--- stdout ---\n{r.stdout}\n"
+        f"--- stderr ---\n{r.stderr}")
+    return r.stdout

@@ -398,9 +398,9 @@ def test_shell_ec_encode_fuses_one_rpc_per_server(tmp_path, monkeypatch):
     calls = []
     orig = store_ec.generate_ec_shards_batch
 
-    def spy(store, vids, backend="auto"):
+    def spy(store, vids, backend="auto", **kw):
         calls.append(sorted(vids))
-        return orig(store, vids, backend=backend)
+        return orig(store, vids, backend=backend, **kw)
 
     monkeypatch.setattr(store_ec, "generate_ec_shards_batch", spy)
     c = Cluster(tmp_path, n_volume_servers=1, volumes_per_server=8,

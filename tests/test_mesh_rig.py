@@ -1,0 +1,108 @@
+"""Real >1-device (and =1-device) coverage at device counts the pinned
+8-device test process cannot reach: mesh factoring, sharded encode,
+shard rotation, the unified mesh scheduler, and the single-device
+fallback ladder, each in a fresh subprocess forced onto its own
+virtual CPU platform (tests/device_rig.py)."""
+
+from tests.device_rig import run_under_devices
+
+
+def test_six_device_mesh_end_to_end():
+    """Non-power-of-two pod: make_mesh factors (3, 2); sharded encode,
+    rotate_shards, and the unified mesh scheduler all byte-match the
+    host path."""
+    out = run_under_devices(6, """
+        import os, tempfile
+        import numpy as np
+        import jax
+        assert len(jax.devices()) == 6
+        from seaweedfs_tpu.ec.encoder import (
+            shard_file_name, write_ec_files)
+        from seaweedfs_tpu.ops.rs_code import ReedSolomon, DATA_SHARDS
+        from seaweedfs_tpu.parallel import (
+            make_mesh, mesh_write_ec_files, rotate_shards,
+            sharded_encode)
+
+        mesh = make_mesh()
+        assert (mesh.shape["dp"], mesh.shape["sp"]) == (3, 2), \\
+            dict(mesh.shape)
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=(3, DATA_SHARDS, 256),
+                            dtype=np.uint8)
+        got = np.asarray(sharded_encode(mesh, data))
+        want = ReedSolomon(backend="numpy").encode(data)
+        assert (got == want).all()
+        full = np.concatenate([data, got], axis=1)
+        rot = np.asarray(rotate_shards(mesh, jax.numpy.asarray(full),
+                                       shift=1))
+        assert (rot == np.roll(full, 1, axis=0)).all()
+
+        small = 64 << 10
+        with tempfile.TemporaryDirectory() as d:
+            bases = []
+            for v, size in enumerate(
+                    [small * 10 * 2 + 7, small * 10, small * 10 - 1]):
+                base = os.path.join(d, str(v))
+                with open(base + ".dat", "wb") as f:
+                    f.write(rng.integers(0, 256, size,
+                                         dtype=np.uint8).tobytes())
+                bases.append(base)
+            mesh_write_ec_files(bases, mesh=mesh, small_block=small,
+                                bucket_mb=2)
+            for base in bases:
+                ref = base + "_r"
+                os.link(base + ".dat", ref + ".dat")
+                write_ec_files(ref, backend="numpy", small_block=small)
+                for i in range(14):
+                    with open(shard_file_name(base, i), "rb") as f:
+                        g = f.read()
+                    with open(shard_file_name(ref, i), "rb") as f:
+                        assert g == f.read(), (base, i)
+        print("OK6")
+        """)
+    assert "OK6" in out
+
+
+def test_single_device_pod_falls_back_to_fleet():
+    """dp=sp=1: the pod entry point must take the per-device fleet
+    ladder (MeshUnavailable), count the fallback, and still produce
+    byte-identical shards — the zero-surprise path for CPU-only
+    hosts."""
+    out = run_under_devices(1, """
+        import os, tempfile
+        import numpy as np
+        import jax
+        assert len(jax.devices()) == 1
+        from seaweedfs_tpu.ec.encoder import (
+            shard_file_name, write_ec_files)
+        from seaweedfs_tpu.parallel import pod_write_ec_files
+        from seaweedfs_tpu.stats.metrics import \\
+            FleetMeshFallbacksCounter
+
+        rng = np.random.default_rng(1)
+        small = 64 << 10
+        with tempfile.TemporaryDirectory() as d:
+            bases = []
+            for v in range(2):
+                base = os.path.join(d, str(v))
+                with open(base + ".dat", "wb") as f:
+                    f.write(rng.integers(0, 256, small * 10 + v,
+                                         dtype=np.uint8).tobytes())
+                bases.append(base)
+            path = pod_write_ec_files(bases, backend="numpy",
+                                      small_block=small)
+            assert path == "fleet", path
+            assert FleetMeshFallbacksCounter.labels(
+                "unavailable").value == 1
+            for base in bases:
+                ref = base + "_r"
+                os.link(base + ".dat", ref + ".dat")
+                write_ec_files(ref, backend="numpy", small_block=small)
+                for i in range(14):
+                    with open(shard_file_name(base, i), "rb") as f:
+                        g = f.read()
+                    with open(shard_file_name(ref, i), "rb") as f:
+                        assert g == f.read(), (base, i)
+        print("OK1")
+        """)
+    assert "OK1" in out

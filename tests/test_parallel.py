@@ -148,6 +148,96 @@ def test_sharded_write_ec_files_edge_cases(mesh, tmp_path):
         sharded_write_ec_files(mesh, [big])
 
 
+def test_make_mesh_factoring_pinned(mesh):
+    """The sp loop's factoring, pinned per device count (ISSUE 11
+    satellite): sp is the largest power of two with sp^2*4 <= n that
+    divides n; dp gets the rest. Non-power-of-two counts must factor,
+    not crash — a 6-chip pod is a real pod."""
+    devs = jax.devices()
+    expected = {1: (1, 1), 2: (2, 1), 3: (3, 1), 4: (2, 2),
+                5: (5, 1), 6: (3, 2), 7: (7, 1), 8: (4, 2)}
+    for n, (dp, sp) in expected.items():
+        m = make_mesh(devices=devs[:n])
+        assert (m.shape["dp"], m.shape["sp"]) == (dp, sp), \
+            f"n={n}: got ({m.shape['dp']}, {m.shape['sp']})"
+        assert m.shape["dp"] * m.shape["sp"] == n
+
+
+def test_sharded_encode_on_non_pow2_mesh(tmp_path):
+    """A 6-device (3, 2) mesh — dp 3, sp 2 — must encode exactly like
+    the host: mesh factoring edge coverage beyond the 8-device
+    fixture."""
+    m = make_mesh(devices=jax.devices()[:6])
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, size=(6, DATA_SHARDS, 512),
+                        dtype=np.uint8)
+    got = np.asarray(sharded_encode(m, data))
+    want = ReedSolomon(backend="numpy").encode(data)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_round_robin_by_size(tmp_path):
+    from seaweedfs_tpu.parallel import round_robin_by_size
+
+    sizes = {"a": 50, "b": 40, "c": 30, "d": 20, "e": 10, "f": 0}
+    bases = []
+    for name, size in sizes.items():
+        base = str(tmp_path / name)
+        with open(base + ".dat", "wb") as f:
+            f.write(b"x" * size)
+        bases.append(base)
+    # n=1: everything in one bucket, largest first
+    one = round_robin_by_size(bases, 1)
+    assert len(one) == 1 and len(one[0]) == 6
+    assert [os.path.basename(b) for b in one[0][:2]] == ["a", "b"]
+    # LPT deal: each volume lands on the then-lightest bucket, so the
+    # byte loads balance exactly here: 50+0 / 40+10 / 30+20
+    buckets = round_robin_by_size(bases, 3)
+    loads = sorted(sum(sizes[os.path.basename(b)] for b in bkt)
+                   for bkt in buckets)
+    assert loads == [50, 50, 50]
+    # empty volumes still cost a slot (not all piled on one bucket)
+    empties = []
+    for i in range(4):
+        base = str(tmp_path / f"z{i}")
+        open(base + ".dat", "wb").close()
+        empties.append(base)
+    spread = round_robin_by_size(empties, 2)
+    assert sorted(len(b) for b in spread) == [2, 2]
+    # more buckets than volumes: the extras stay empty
+    assert [len(b) for b in round_robin_by_size(empties, 8)].count(1) == 4
+
+
+def test_sharded_write_ec_files_boundary_sizes(mesh, tmp_path):
+    """ISSUE 11 satellite: the small-block boundary sizes — 0, 1 byte,
+    exactly row_bytes, row_bytes+1 — byte-identical to the host path
+    (padding edges are where layout bugs live)."""
+    from seaweedfs_tpu.ec.encoder import shard_file_name, write_ec_files
+    from seaweedfs_tpu.parallel import sharded_write_ec_files
+
+    small = 16 << 10
+    row_bytes = DATA_SHARDS * small
+    rng = np.random.default_rng(23)
+    sizes = [0, 1, row_bytes, row_bytes + 1]
+    bases = []
+    for v, size in enumerate(sizes):
+        base = str(tmp_path / f"{v + 1}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        bases.append(base)
+    sharded_write_ec_files(mesh, bases, small_block=small)
+    for v, base in enumerate(bases):
+        ref_base = str(tmp_path / f"ref{v + 1}")
+        os.link(base + ".dat", ref_base + ".dat")
+        write_ec_files(ref_base, backend="auto", small_block=small)
+        for i in range(14):
+            with open(shard_file_name(base, i), "rb") as f:
+                got = f.read()
+            with open(shard_file_name(ref_base, i), "rb") as f:
+                want = f.read()
+            assert got == want, f"size {sizes[v]} shard {i} diverged"
+
+
 def test_rotate_shards_permutes_batch(mesh):
     dp = mesh.shape["dp"]
     if dp < 2:

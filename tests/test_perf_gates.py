@@ -849,3 +849,62 @@ def test_scheduler_disabled_overhead():
     with leftover:            # delegate mode: plain real lock
         assert leftover.locked()
     assert not scheduler.armed()
+
+
+def test_mesh_disabled_overhead(tmp_path):
+    """The unified pod-scale mesh scheduler (ISSUE 11) must be
+    zero-cost until a pod entry point actually runs with the mesh
+    enabled — the house zero-cost-until-used contract.
+
+    Three gates. Construction: a default VolumeServer (no -ec.mesh)
+    carries ec_mesh_cfg=None — not an empty dict — so every consumer
+    seam (batch encode, scrub verify, degraded decode) takes its
+    `is None` fast path. Device query: running the default host-fleet
+    batch encode end to end never builds a mesh object and never asks
+    jax for devices (the lazily-cached `_default_mesh`/`_shardings`
+    stay cold). Threads: no mesh-read or other mesh-born thread exists
+    before, during, or after."""
+    import threading
+
+    from seaweedfs_tpu.ec import encoder, store_ec
+    from seaweedfs_tpu.parallel import mesh_fleet
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.needle import Needle
+
+    def mesh_threads():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("mesh-")]
+
+    # deltas, not absolutes: earlier tests in this process may have
+    # legitimately built the default mesh / run mesh passes
+    mesh_misses = mesh_fleet._default_mesh.cache_info().misses
+    shard_misses = mesh_fleet._shardings.cache_info().misses
+    baseline = set(mesh_threads())
+
+    d = tmp_path / "vs"
+    d.mkdir()
+    vs = VolumeServer(master_url="127.0.0.1:1", directories=[str(d)],
+                      port=18999, ec_encoder="numpy")
+    assert vs.ec_mesh_cfg is None, \
+        "default server must carry NO mesh config (None, not {})"
+    assert vs.degraded.use_mesh is False
+    assert vs.scrub.mesh_cfg is None
+
+    # the default batch-encode path end to end: host fleet only
+    blob = bytes(range(256)) * 4
+    for vid in (1, 2):
+        vs.store.add_volume(vid)
+        v = vs.store.find_volume(vid)
+        for i in range(1, 40):
+            v.write_needle(Needle(id=i, cookie=9, data=blob))
+    store_ec.generate_ec_shards_batch(vs.store, [1, 2],
+                                      backend="numpy",
+                                      mesh_cfg=vs.ec_mesh_cfg)
+    vs.store.close()
+
+    assert set(mesh_threads()) == baseline, \
+        "default encode path must never spawn mesh threads"
+    assert mesh_fleet._default_mesh.cache_info().misses == mesh_misses, \
+        "default path must never query jax devices for a mesh"
+    assert mesh_fleet._shardings.cache_info().misses == shard_misses, \
+        "default path must never build mesh shardings"
