@@ -838,6 +838,262 @@ def ingest_pipeline_sweep(chunk_counts=(1, 8, 64),
             "value": headline, "sweep": sweep}
 
 
+def meta_plane_sweep(fanouts=(64, 512), reader_counts=(1, 8)) -> dict:
+    """--meta mode: metadata-plane throughput (ISSUE 12) against REAL
+    CLI subprocesses — in-process servers would share the client's GIL
+    and hide exactly the round-trip elimination this sweep measures.
+
+    Two halves:
+
+      lookup   the 64-chunk-file read workload's lookups: resolve the
+               same 64 distinct vids (a) singly — one gRPC
+               LookupVolume per vid, the pre-ISSUE-12 shape — and
+               (b) through the armed coalescing cache, whose misses
+               fuse into batched /dir/lookup?volumeIds= round trips
+               (the cache is RESET before every timed batched run, so
+               the number measures batching+coalescing, not TTL
+               hits; the hot row measures the hits). Repeated with R
+               concurrent readers so single-flight + coalescing see
+               contention. Best-of-N, paths alternated per house
+               style.
+
+      listing  directory fan-out F x concurrent readers R against two
+               filer subprocesses on the same master — one default,
+               one with -meta.listingCacheMB 64 — plus the
+               correctness probes: the hit-path listing body must be
+               byte-identical to the miss-path body, and a listing
+               taken immediately after a cache-invalidating mutation
+               must show the mutation.
+    """
+    import json as json_mod
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, REPO_ROOT)
+    from seaweedfs_tpu.operation import operations
+    from seaweedfs_tpu.util import http_client
+    from seaweedfs_tpu.wdclient import lookup_cache
+
+    n_vids = int(os.environ.get("BENCH_META_VIDS", "64"))
+    repeats = int(os.environ.get("BENCH_META_REPEATS", "3"))
+    listings_per_reader = int(os.environ.get("BENCH_META_LISTINGS", "40"))
+    free_port, spawn, wait_http = _free_port, _spawn_server, _wait_http
+
+    out = {"metric": "meta_plane_sweep", "vids": n_vids,
+           "lookup": [], "listing": []}
+    procs = []
+    with tempfile.TemporaryDirectory() as d:
+        mport = free_port()
+        master_url = f"127.0.0.1:{mport}"
+        try:
+            procs.append(spawn("master", "-port", str(mport),
+                               "-mdir", os.path.join(d, "m"),
+                               "-volumeSizeLimitMB", "64",
+                               "-pulseSeconds", "0.3"))
+            wait_http(f"http://{master_url}/cluster/status")
+            vport = free_port()
+            procs.append(spawn("volume", "-port", str(vport),
+                               "-dir", os.path.join(d, "v"),
+                               "-max", str(n_vids + 8),
+                               "-mserver", master_url,
+                               "-pulseSeconds", "0.3"))
+            wait_http(f"http://127.0.0.1:{vport}/status")
+            time.sleep(1.0)   # first heartbeats register the node
+
+            with urllib.request.urlopen(
+                    f"http://{master_url}/vol/grow?count={n_vids}",
+                    timeout=30) as r:
+                grown = json_mod.loads(r.read())
+            vids = grown.get("volumeIds") or []
+            assert len(vids) >= n_vids, grown
+
+            def run_singly(readers: int) -> float:
+                lookup_cache.reset()
+
+                def worker():
+                    for vid in vids:
+                        operations.lookup(master_url, vid)
+                t0 = time.perf_counter()
+                ts = [threading.Thread(target=worker)
+                      for _ in range(readers)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return time.perf_counter() - t0
+
+            def run_batched(readers: int, hot: bool = False) -> float:
+                lookup_cache.reset()
+                lookup_cache.configure(enable=True, ttl_s=30.0,
+                                       coalesce_ms=2.0)
+                if hot:
+                    operations.lookup_many(master_url, vids)
+
+                def worker():
+                    operations.lookup_many(master_url, vids)
+                t0 = time.perf_counter()
+                ts = [threading.Thread(target=worker)
+                      for _ in range(readers)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                dt = time.perf_counter() - t0
+                lookup_cache.reset()
+                return dt
+
+            operations.lookup(master_url, vids[0])   # warm stubs/pool
+            for readers in reader_counts:
+                singly_s, batched_s, hot_s = [], [], []
+                for _ in range(max(1, repeats)):   # alternated
+                    singly_s.append(run_singly(readers))
+                    batched_s.append(run_batched(readers))
+                    hot_s.append(run_batched(readers, hot=True))
+                total = len(vids) * readers
+                out["lookup"].append({
+                    "readers": readers,
+                    "singly_lookups_s":
+                        round(total / min(singly_s), 1),
+                    "batched_lookups_s":
+                        round(total / min(batched_s), 1),
+                    "hot_lookups_s": round(total / min(hot_s), 1),
+                    "speedup":
+                        round(min(singly_s) / min(batched_s), 3),
+                })
+
+            # -- listing half --------------------------------------------------
+            fports = {}
+            for tag, extra in (("off", []),
+                               ("on", ["-meta.listingCacheMB", "64"])):
+                fport = free_port()
+                fports[tag] = fport
+                procs.append(spawn(
+                    "filer", "-port", str(fport), "-master", master_url,
+                    "-store", "sqlite",
+                    "-dir", os.path.join(d, f"f-{tag}"), *extra))
+                wait_http(f"http://127.0.0.1:{fport}/")
+
+            blob = b"meta-bench" * 10
+            for fanout in fanouts:
+                for tag, fport in fports.items():
+                    for i in range(fanout):
+                        r = http_client.request(
+                            "POST",
+                            f"127.0.0.1:{fport}/bench{fanout}/f{i:04d}",
+                            body=blob)
+                        assert r.status == 201, (tag, r.status)
+
+                def list_once(fport, fanout):
+                    r = http_client.request(
+                        "GET",
+                        f"127.0.0.1:{fports[fport]}/bench{fanout}/"
+                        f"?limit=2048",
+                        headers={"Accept": "application/json"})
+                    assert r.status == 200, r.status
+                    return r.body
+
+                # byte-identity: miss-path body (first ever listing)
+                # vs hit-path body on the SAME filer
+                miss_body = list_once("on", fanout)
+                hit_body = list_once("on", fanout)
+                assert miss_body == hit_body, \
+                    "listing hit bytes differ from miss bytes"
+
+                def run_listings(tag, readers) -> float:
+                    def worker():
+                        for _ in range(listings_per_reader):
+                            list_once(tag, fanout)
+                    t0 = time.perf_counter()
+                    ts = [threading.Thread(target=worker)
+                          for _ in range(readers)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    return time.perf_counter() - t0
+
+                for readers in reader_counts:
+                    off_s, on_s = [], []
+                    for _ in range(max(1, repeats)):   # alternated
+                        off_s.append(run_listings("off", readers))
+                        on_s.append(run_listings("on", readers))
+                    total = listings_per_reader * readers
+                    out["listing"].append({
+                        "fanout": fanout, "readers": readers,
+                        "store_listings_s":
+                            round(total / min(off_s), 1),
+                        "cached_listings_s":
+                            round(total / min(on_s), 1),
+                        "speedup": round(min(off_s) / min(on_s), 3),
+                    })
+
+                # correctness: a cache-invalidating mutation must be
+                # visible in the very next listing
+                r = http_client.request(
+                    "POST",
+                    f"127.0.0.1:{fports['on']}/bench{fanout}/zz-new",
+                    body=blob)
+                assert r.status == 201, r.status
+                fresh = json_mod.loads(list_once("on", fanout))
+                names = [e["FullPath"].rsplit("/", 1)[1]
+                         for e in fresh["Entries"]]
+                assert "zz-new" in names, \
+                    "listing after mutation is stale"
+                out.setdefault("correct_after_mutation", True)
+
+            # metadata-layer cost per fanout: the end-to-end HTTP rows
+            # above are dominated by JSON render + socket work, which
+            # masks what the cache changes — time Filer.list_entries
+            # itself (store walk vs page hit; the hit never touches
+            # the store, which is the whole point on redis/mysql-class
+            # stores where a walk is a network round trip)
+            from seaweedfs_tpu.filer import Filer, SqliteStore
+            from seaweedfs_tpu.filer.filer import new_entry
+            from seaweedfs_tpu.filer.listing_cache import ListingCache
+            for fanout in fanouts:
+                f = Filer(SqliteStore(
+                    os.path.join(d, f"meta-{fanout}.db")))
+                for i in range(fanout):
+                    f.create_entry("/b", new_entry(f"f{i:04d}"))
+
+                def timed(fn, n=200):
+                    fn()
+                    t0 = time.perf_counter()
+                    for _ in range(n):
+                        fn()
+                    return (time.perf_counter() - t0) / n * 1e6
+
+                walk_us = timed(
+                    lambda: f.list_entries("/b", limit=2048))
+                f.attach_listing_cache(ListingCache(64 << 20))
+                hit_us = timed(
+                    lambda: f.list_entries("/b", limit=2048))
+                assert f.listing_cache.stats()["hits"] >= 200
+                f.close()
+                out.setdefault("listing_meta_layer", []).append({
+                    "fanout": fanout,
+                    "store_walk_us": round(walk_us),
+                    "cache_hit_us": round(hit_us),
+                    "speedup": round(walk_us / hit_us, 3),
+                })
+        finally:
+            lookup_cache.reset()
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+    headline = max((row["speedup"] for row in out["lookup"]),
+                   default=0.0)
+    out["unit"] = "speedup"
+    out["value"] = headline
+    return out
+
+
 def chaos_sweep() -> dict:
     """Resilience scenario sweep (ISSUE 6 satellite): an in-process
     master + 3 volume servers take concurrent reads while the sweep
@@ -1295,6 +1551,16 @@ def main() -> None:
     if "--lint" in sys.argv:
         line = lint_bench()
         with open(os.path.join(REPO_ROOT, "BENCH_LINT.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
+    if "--meta" in sys.argv:
+        # meta mode is host-pipeline only: metadata-plane lookup +
+        # listing throughput against subprocess servers, not the
+        # kernel headline
+        line = meta_plane_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_META.json"),
                   "w") as f:
             json.dump(line, f, indent=1)
         print(json.dumps(line), flush=True)

@@ -464,11 +464,55 @@ def _filer_parser() -> argparse.ArgumentParser:
     p.add_argument("-peers", default="",
                    help="comma-separated host:port of ALL filers in "
                         "this cluster (merged metadata view)")
+    p.add_argument("-meta.lookupTTL", dest="meta_lookup_ttl_s",
+                   type=float, default=0.0,
+                   help="arm the coalescing volume-lookup cache: "
+                        "positive answers live this many seconds, "
+                        "concurrent misses single-flight, and misses "
+                        "within the coalesce window fuse into one "
+                        "batched /dir/lookup (0 = off, one gRPC "
+                        "round trip per lookup)")
+    p.add_argument("-meta.lookupNegativeTTL",
+                   dest="meta_lookup_negative_ttl_s", type=float,
+                   default=2.0,
+                   help="seconds a NOT-FOUND lookup answer is served "
+                        "from cache (bounds miss storms on deleted "
+                        "volumes; only with -meta.lookupTTL)")
+    p.add_argument("-meta.lookupCoalesceMs",
+                   dest="meta_lookup_coalesce_ms", type=float,
+                   default=2.0,
+                   help="how long a lookup miss waits for siblings "
+                        "to join its batched master round trip "
+                        "(only with -meta.lookupTTL)")
+    p.add_argument("-meta.lookupBatchMax",
+                   dest="meta_lookup_batch_max", type=int, default=128,
+                   help="most vids fused into one batched lookup "
+                        "round trip (only with -meta.lookupTTL)")
+    p.add_argument("-meta.listingCacheMB",
+                   dest="meta_listing_cache_mb", type=int, default=0,
+                   help="RAM budget for the directory-listing page "
+                        "cache, invalidated by the metadata event "
+                        "log (0 = off, every listing walks the "
+                        "filer store)")
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_resilience_args(p)
     _add_trace_args(p)
     return p
+
+
+def _configure_meta(opts) -> None:
+    """Arm the process-wide coalescing lookup cache from the -meta.*
+    flags (wdclient/lookup_cache.py module seam). Off by default: the
+    module stays disabled and no call site constructs a cache."""
+    ttl = getattr(opts, "meta_lookup_ttl_s", 0.0)
+    if ttl and ttl > 0:
+        from seaweedfs_tpu.wdclient import lookup_cache
+        lookup_cache.configure(
+            enable=True, ttl_s=ttl,
+            negative_ttl_s=opts.meta_lookup_negative_ttl_s,
+            coalesce_ms=opts.meta_lookup_coalesce_ms,
+            batch_max=opts.meta_lookup_batch_max)
 
 
 def _build_filer(opts):
@@ -492,7 +536,8 @@ def _build_filer(opts):
         ingest_parallelism=opts.ingest_parallelism,
         assign_lease_count=opts.assign_lease_count,
         hedge_reads=opts.resilience_hedge,
-        hedge_delay_ms=opts.resilience_hedge_delay_ms)
+        hedge_delay_ms=opts.resilience_hedge_delay_ms,
+        listing_cache_mb=getattr(opts, "meta_listing_cache_mb", 0))
     # notification.toml: publish every metadata mutation to the first
     # enabled [notification.X] queue (reference filer.go
     # LoadConfiguration("notification"))
@@ -510,6 +555,7 @@ def run_filer(args) -> int:
     opts = _filer_parser().parse_args(args)
     _configure_resilience(opts)
     _configure_trace(opts)
+    _configure_meta(opts)   # BEFORE the build: MasterClient arms at init
     _maybe_start_metrics(opts, role="filer")
     fs = _build_filer(opts)
     fs.start()

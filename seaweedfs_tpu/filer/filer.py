@@ -76,6 +76,21 @@ class Filer:
         # fast path (wired by FilerServer / MetaAggregator)
         self.signature: int = 0
         self.on_meta_event: Optional[Callable[[], None]] = None
+        # listing cache (-meta.listingCacheMB): ABSENT — not merely
+        # empty — unless attached, so the disabled list path is one
+        # None check (attach_listing_cache wires the event log to it)
+        self.listing_cache = None
+
+    def attach_listing_cache(self, cache) -> None:
+        """Arm the listing cache: list_entries consults it, and the
+        METADATA EVENT LOG invalidates it — every appended event fires
+        the log's on_append hook into the cache, so a listing served
+        from cache can never predate the newest recorded mutation of
+        its directory (filer/listing_cache.py)."""
+        self.listing_cache = cache
+        self.meta_log.on_append = \
+            lambda directory, ev: cache.apply_event(directory, ev,
+                                                    reason="local")
 
     def _delete_chunks(self, chunks: List[filer_pb2.FileChunk]) -> None:
         """Hand chunks to the GC hook, expanding manifest chunks first.
@@ -250,6 +265,30 @@ class Filer:
                      inclusive: bool = False, limit: int = 1024,
                      prefix: str = "") -> List[filer_pb2.Entry]:
         directory = normalize_path(directory)
+        cache = self.listing_cache
+        if cache is not None:
+            page = cache.get(directory, start_name, inclusive, limit,
+                             prefix)
+            if page is None:
+                # generation BEFORE the walk: a mutation landing
+                # mid-walk bumps it and the put below is refused —
+                # the cache can never hold a page older than the
+                # newest logged event of this directory
+                gen = cache.generation(directory)
+                from seaweedfs_tpu.stats import trace
+                sp = trace.span("meta.listing_fill", dir=directory) \
+                    if trace.is_enabled() else trace.NOOP
+                with sp:
+                    page = list(self.store.list_directory_entries(
+                        directory, start_name, inclusive, limit,
+                        prefix))
+                cache.put(directory, start_name, inclusive, limit,
+                          prefix, page, gen)
+            # the TTL-expiry filter runs on EVERY serve (hit or miss):
+            # lazy expiry emits no event, so cached raw pages may
+            # still hold entries whose clock ran out
+            now = _now()
+            return [e for e in page if not entry_expired(e, now)]
         out = []
         now = _now()
         for e in self.store.list_directory_entries(

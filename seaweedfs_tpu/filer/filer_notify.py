@@ -11,7 +11,7 @@ from __future__ import annotations
 import calendar
 import os
 import time
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from seaweedfs_tpu.pb import filer_pb2
 from seaweedfs_tpu.util.log_buffer import LogBuffer, LogEntry
@@ -63,6 +63,13 @@ class MetaLog:
             os.makedirs(log_dir, exist_ok=True)
         self.buffer = LogBuffer(flush_seconds=flush_seconds,
                                 flush_fn=self._flush if log_dir else None)
+        # fires for every appended event, AFTER the record is in the
+        # buffer — the listing cache's invalidation seam (ISSUE 12):
+        # the event log itself drives cache drops, on the local log
+        # (reason "local") and on the meta-aggregator's peer log
+        # (reason "peer") alike. None (the default) costs one check.
+        self.on_append: Optional[Callable[
+            [str, filer_pb2.EventNotification], None]] = None
 
     # -- write ----------------------------------------------------------------
 
@@ -74,6 +81,11 @@ class MetaLog:
         ts = self.buffer.add(rec.SerializeToString(),
                              key_hash=hash(directory) & 0x7FFFFFFF,
                              ts_ns=ts_ns)
+        if self.on_append is not None:
+            # ordering contract: the event is RECORDED before any
+            # cache drops, so a reader that re-lists after observing
+            # the invalidation also finds the event in the log
+            self.on_append(directory, event)
         return ts
 
     def _flush(self, start_ts: int, stop_ts: int, blob: bytes) -> None:

@@ -205,6 +205,17 @@ def submit(master_url: str, data: bytes, filename: str = "",
 
 
 def lookup(master_url: str, vid: int, collection: str = "") -> List[str]:
+    from seaweedfs_tpu.wdclient import lookup_cache
+    if lookup_cache.enabled:
+        # coalescing single-flight + TTL cache over the batched HTTP
+        # lookup surface. NOT-FOUND answers are cached too (the short
+        # negative TTL): a miss storm on a deleted volume costs one
+        # batched round trip per window instead of hammering the
+        # master with a fresh RPC per call (ISSUE 12 satellite).
+        res = lookup_cache.for_master(master_url, collection).lookup(vid)
+        if res.error:
+            raise RuntimeError(res.error)
+        return [l.url for l in res.locations]
     resp = master_stub(master_url).LookupVolume(
         master_pb2.LookupVolumeRequest(volume_ids=[str(vid)],
                                        collection=collection))
@@ -215,9 +226,34 @@ def lookup(master_url: str, vid: int, collection: str = "") -> List[str]:
     return []
 
 
+def lookup_many(master_url: str, vids,
+                collection: str = "") -> Dict[int, List[str]]:
+    """Resolve many vids at once. With the meta lookup cache enabled
+    every miss rides ONE batched ``/dir/lookup?volumeIds=`` round trip
+    (and hits/negatives answer locally); disabled it is exactly a loop
+    over lookup() — same RPCs, same order, no behavior change. Per-vid
+    failures surface as [] — callers that need the reason use
+    lookup()."""
+    from seaweedfs_tpu.wdclient import lookup_cache
+    ordered = list(dict.fromkeys(vids))
+    if lookup_cache.enabled:
+        res = lookup_cache.for_master(
+            master_url, collection).lookup_many(ordered)
+        return {vid: [l.url for l in res[vid].locations]
+                for vid in ordered}
+    out: Dict[int, List[str]] = {}
+    for vid in ordered:
+        try:
+            out[vid] = lookup(master_url, vid, collection)
+        except RuntimeError:
+            out[vid] = []
+    return out
+
+
 def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
     from seaweedfs_tpu.operation.file_id import parse_fid
-    urls = lookup(master_url, parse_fid(fid).volume_id)
+    vid = parse_fid(fid).volume_id
+    urls = lookup(master_url, vid)
     if not urls:
         raise RuntimeError(f"no locations for {fid}")
     # open-breaker replicas sort last, and a failed replica falls
@@ -228,6 +264,12 @@ def download(master_url: str, fid: str, timeout: float = 60.0) -> bytes:
             return download_url(f"{url}/{fid}", timeout=timeout)
         except (OSError, RuntimeError) as e:
             last_err = e
+    from seaweedfs_tpu.wdclient import lookup_cache
+    if lookup_cache.enabled:
+        # every returned location failed the actual read: the cached
+        # belief was observed wrong — drop it so the next lookup
+        # re-asks instead of serving the same dead set for a full TTL
+        lookup_cache.invalidate(master_url, vid)
     raise last_err
 
 
@@ -266,6 +308,11 @@ def delete_files(master_url: str, fids: List[str]) -> List[dict]:
             by_vid.setdefault(parse_fid(fid).volume_id, []).append(fid)
         except ValueError as e:
             results.append({"fid": fid, "error": str(e)})
+    from seaweedfs_tpu.wdclient import lookup_cache
+    if lookup_cache.enabled and len(by_vid) > 1:
+        # warm the coalescing cache in ONE batched round trip; the
+        # per-vid lookups below answer locally (negatives included)
+        lookup_cache.for_master(master_url).lookup_many(list(by_vid))
     by_server: Dict[str, List[str]] = {}
     for vid, group in by_vid.items():  # one lookup per distinct volume
         try:

@@ -565,6 +565,42 @@ class _MeshRun:
                 self._pipe.drain()
 
 
+def _drive_buckets(gen, dp: int, readers: int,
+                   submit_read: Callable, flush: Callable) -> None:
+    """THE fill/pack/flush dispatch-driver loop (ROADMAP item 2(e)):
+    pull work units off `gen`, keep up to max(readers, 2*dp) reads in
+    flight on the run's pool, retire them in submission order into
+    dp-sized packs, and hand each full (or final short) pack to
+    `flush`, which builds the fused bucket and submits its dispatch.
+
+    Encode, verify, and rebuild used to carry a private copy of this
+    loop each; they now all drive their passes through this ONE
+    function — `submit_read(item) -> future-like` and
+    `flush([(item, result), ...])` carry the per-op shape — so the
+    schedule-explorer interleavings that prove the encode seam
+    (tests/test_mesh_fleet.py) provably cover all three ops.
+    """
+    inflight: deque = deque()
+    prefetch = max(readers, 2 * dp)
+
+    def fill() -> None:
+        while len(inflight) < prefetch:
+            nxt = next(gen, None)
+            if nxt is None:
+                break
+            inflight.append((nxt, submit_read(nxt)))
+
+    fill()
+    pack: List = []
+    while inflight:
+        item, fut = inflight.popleft()
+        pack.append((item, fut.result()))
+        fill()
+        if len(pack) == dp or not inflight:
+            flush(pack)
+            pack = []
+
+
 def _span_geometry(dp: int, sp: int, small_block: int,
                    bucket_mb: int) -> Tuple[int, int]:
     """(span_rows, lanes): rows of small_block per span slot, and the
@@ -662,23 +698,17 @@ def mesh_write_ec_files(base_names: Sequence[str], mesh=None,
                 files.create(v.base, range(TOTAL_SHARDS))
         gen = _fleet._round_robin_spans(
             [v for v in vols if v.n_rows > 0], span_rows)
-        inflight: deque = deque()
-        prefetch = max(readers, 2 * dp)
 
-        def fill() -> None:
-            while len(inflight) < prefetch:
-                nxt = next(gen, None)
-                if nxt is None:
-                    break
-                v, row0, rows = nxt
-                inflight.append((v, rows, run.pool.submit(
-                    _read_span_matrix, v.base, row0, rows, row_bytes,
-                    small_block, token)))
+        def submit_read(item):
+            v, row0, rows = item
+            return run.pool.submit(
+                _read_span_matrix, v.base, row0, rows, row_bytes,
+                small_block, token)
 
         def flush(pack) -> None:
             bucket = np.zeros((dp, DATA_SHARDS, lanes), dtype=np.uint8)
             tagged, live = [], 0
-            for slot, (v, rows, m) in enumerate(pack):
+            for slot, ((v, _row0, rows), m) in enumerate(pack):
                 w = rows * small_block
                 bucket[slot, :, :w] = m
                 live += w * DATA_SHARDS
@@ -690,15 +720,7 @@ def mesh_write_ec_files(base_names: Sequence[str], mesh=None,
                     _write_parity_rows, files, v.base, w)))
             run.submit(bucket, None, tagged, live)
 
-        fill()
-        pack = []
-        while inflight:
-            v, rows, fut = inflight.popleft()
-            pack.append((v, rows, fut.result()))
-            fill()
-            if len(pack) == dp or not inflight:
-                flush(pack)
-                pack = []
+        _drive_buckets(gen, dp, readers, submit_read, flush)
         ok = True
     finally:
         try:
@@ -808,21 +830,14 @@ def mesh_verify_ec_files(base_names: Sequence[str], mesh=None,
     ok = False
     try:
         gen = gen_spans()
-        inflight: deque = deque()
-        prefetch = max(readers, 2 * dp)
 
-        def fill() -> None:
-            while len(inflight) < prefetch:
-                nxt = next(gen, None)
-                if nxt is None:
-                    break
-                v, offset = nxt
-                if throttler is not None:
-                    parity, _, _ = meta[v.tag]
-                    throttler.maybe_slowdown(
-                        (DATA_SHARDS + len(parity)) * span)
-                inflight.append((v, offset,
-                                 run.pool.submit(read_one, v, offset)))
+        def submit_read(item):
+            v, offset = item
+            if throttler is not None:
+                parity, _, _ = meta[v.tag]
+                throttler.maybe_slowdown(
+                    (DATA_SHARDS + len(parity)) * span)
+            return run.pool.submit(read_one, v, offset)
 
         def retire_span(v: "_fleet._VolState", offset: int, out) -> None:
             counts, firsts = out
@@ -854,7 +869,7 @@ def mesh_verify_ec_files(base_names: Sequence[str], mesh=None,
             stored = np.zeros((dp, PARITY_SHARDS, lanes), dtype=np.uint8)
             limits = np.zeros((dp, PARITY_SHARDS), dtype=np.int32)
             tagged, livebytes = [], 0
-            for slot, (v, offset, (d, s, lim)) in enumerate(pack):
+            for slot, ((v, offset), (d, s, lim)) in enumerate(pack):
                 bucket[slot] = d
                 stored[slot] = s
                 limits[slot] = lim
@@ -864,15 +879,7 @@ def mesh_verify_ec_files(base_names: Sequence[str], mesh=None,
                     retire_span, v, offset)))
             run.submit(bucket, (stored, limits), tagged, livebytes)
 
-        fill()
-        pack = []
-        while inflight:
-            item = inflight.popleft()
-            pack.append((item[0], item[1], item[2].result()))
-            fill()
-            if len(pack) == dp or not inflight:
-                flush(pack)
-                pack = []
+        _drive_buckets(gen, dp, readers, submit_read, flush)
         ok = True
     finally:
         try:
@@ -996,22 +1003,14 @@ def _mesh_rebuild_group(mesh, present: Tuple[int, ...],
             files.create(v.base, write)
         gen = ((v, row0 * span) for v, row0, _r in
                _fleet._round_robin_spans(vols, 1))
-        inflight: deque = deque()
-        prefetch = max(readers, 2 * dp)
 
-        def fill() -> None:
-            while len(inflight) < prefetch:
-                nxt = next(gen, None)
-                if nxt is None:
-                    break
-                v, offset = nxt
-                inflight.append((v, offset,
-                                 run.pool.submit(read_rows, v, offset)))
+        def submit_read(item):
+            return run.pool.submit(read_rows, *item)
 
         def flush(pack) -> None:
             bucket = np.zeros((dp, n_rows, lanes), dtype=np.uint8)
             tagged, livebytes = [], 0
-            for slot, (v, offset, rows) in enumerate(pack):
+            for slot, ((v, offset), rows) in enumerate(pack):
                 bucket[slot] = rows
                 livebytes += n_rows * min(span,
                                           max(v.dat_size - offset, 0))
@@ -1019,15 +1018,7 @@ def _mesh_rebuild_group(mesh, present: Tuple[int, ...],
                     retire_span, v, offset)))
             run.submit(bucket, None, tagged, livebytes)
 
-        fill()
-        pack = []
-        while inflight:
-            item = inflight.popleft()
-            pack.append((item[0], item[1], item[2].result()))
-            fill()
-            if len(pack) == dp or not inflight:
-                flush(pack)
-                pack = []
+        _drive_buckets(gen, dp, readers, submit_read, flush)
         ok = True
     finally:
         try:

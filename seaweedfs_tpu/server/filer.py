@@ -172,7 +172,8 @@ class FilerServer:
                  ingest_parallelism: int = 8,
                  assign_lease_count: int = 0,
                  hedge_reads: bool = False,
-                 hedge_delay_ms: float = 10.0):
+                 hedge_delay_ms: float = 10.0,
+                 listing_cache_mb: int = 0):
         self.master_url = master_url
         self.ip = ip
         self.port = port
@@ -210,6 +211,14 @@ class FilerServer:
         backend = make_filer_store(store, meta_dir, store_options)
         self.filer = Filer(backend,
                            log_dir=f"{meta_dir}/logs" if meta_dir else None)
+        # listing cache (-meta.listingCacheMB): absent — not merely
+        # empty — unless sized; when armed, list_entries pages skip
+        # the store and the metadata event log drops them on mutation
+        self.listing_cache = None
+        if listing_cache_mb > 0:
+            from seaweedfs_tpu.filer.listing_cache import ListingCache
+            self.listing_cache = ListingCache(listing_cache_mb << 20)
+            self.filer.attach_listing_cache(self.listing_cache)
         self.filer.on_delete_chunks = self._delete_chunks_async
         self.filer.fetch_chunk_fn = lambda c: stream.fetch_chunk_bytes(
             self.lookup_fid_urls, c.file_id, bytes(c.cipher_key),
@@ -246,6 +255,16 @@ class FilerServer:
                 signature=self.filer.signature,
                 log_dir=f"{meta_dir}/aggr-logs" if meta_dir else None)
             self.filer.on_meta_event = self.meta_aggregator.wake
+            if self.listing_cache is not None:
+                # PEER mutations arrive through the aggregator's
+                # subscription into its own MetaLog — the same
+                # on_append seam invalidates here with reason="peer",
+                # the contract that lets replica filers serve listings
+                # without serving peers' stale pages
+                lc = self.listing_cache
+                self.meta_aggregator.aggr_log.on_append = \
+                    lambda directory, ev: lc.apply_event(
+                        directory, ev, reason="peer")
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -343,6 +362,13 @@ class FilerServer:
         locs = self.master_client.lookup(vid)
         if locs:
             return [l.url for l in locs]
+        if self.master_client.lookup_cache_enabled:
+            # the client's coalescing cache already asked the master
+            # (and holds the negative answer under its TTL); falling
+            # through to operations.lookup would consult a SECOND
+            # process-wide cache for the same master — doubled miss
+            # RPCs, and its entries dodge invalidate_lookup
+            return []
         return operations.lookup(self.master_url, vid)
 
     def _assign(self, collection: str = "", replication: str = "",
@@ -928,6 +954,20 @@ def _make_http_handler(fs: FilerServer):
                     self.send_header(k, v)
                 self.end_headers()
                 return
+            if fs.master_client.lookup_cache_enabled:
+                # only chunks the requested window actually touches: a
+                # 1KB Range read of a 10,000-chunk file must not
+                # resolve 10,000 vids the stream will never fetch
+                chunk_vids = {int(c.file_id.split(",")[0])
+                              for c in entry.chunks
+                              if c.file_id and c.offset < offset + length
+                              and c.offset + c.size > offset}
+                if len(chunk_vids) > 1:
+                    # resolve every chunk's volume in ONE batched
+                    # master round trip; the per-chunk lookups inside
+                    # stream_content then answer from the cache (a
+                    # 64-chunk file used to cost up to 64 round trips)
+                    fs.master_client.lookup_many(chunk_vids)
             try:
                 data = b"".join(stream.stream_content(
                     fs.lookup_fid_urls, list(entry.chunks), offset,
@@ -936,6 +976,18 @@ def _make_http_handler(fs: FilerServer):
                 self._json({"error": str(e)}, code=504)
                 return
             except IOError as e:
+                # the FAILED chunk's fetch exhausted every replica the
+                # lookup returned: drop that vid's cached belief so
+                # the retry re-asks the master. The error text is
+                # authoritative for WHICH vid (manifest-inner chunks
+                # never appear in entry.chunks, so no membership
+                # check); unrecognized text invalidates NOTHING —
+                # blanket-dropping all 64 would turn one bad volume
+                # into a 64-vid re-resolve storm on every retry.
+                import re as _re
+                m = _re.search(r"fetch (\d+),", str(e))
+                if m:
+                    fs.master_client.invalidate_lookup(int(m.group(1)))
                 self._json({"error": str(e)}, code=500)
                 return
             self._reply(code, data, headers)

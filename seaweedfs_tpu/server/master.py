@@ -808,13 +808,37 @@ class MasterServer:
                 "publicUrl": locs[0].public_url, "count": count}
 
     def http_lookup(self, params: dict) -> dict:
+        """GET /dir/lookup. Legacy ``volumeId``/``fileId`` answers ONE
+        vid in the reference shape (byte-identical; the comma there
+        belongs to the fid grammar ``<vid>,<key><cookie>``). The
+        batched ``volumeIds=a,b,c`` surface (ISSUE 12) answers every
+        vid as its own result-or-error entry, so one bad vid can never
+        fail the batch — the wdclient coalescing cache's transport."""
+        collection = params.get("collection", [""])[0]
+        if "volumeIds" in params:
+            out = []
+            for part in params.get("volumeIds", [""])[0].split(","):
+                try:
+                    vid = int(part)
+                except ValueError:
+                    out.append({"volumeId": part,
+                                "error": f"bad volume id {part!r}"})
+                    continue
+                locs = self.lookup_locations(vid, collection)
+                if locs:
+                    out.append({"volumeId": str(vid),
+                                "locations": [{"url": u, "publicUrl": p}
+                                              for u, p in locs]})
+                else:
+                    out.append({"volumeId": str(vid),
+                                "error": "volume not found"})
+            return {"volumeIdLocations": out}
         raw = params.get("volumeId", params.get("fileId", [""]))[0]
         try:
             vid = int(raw.split(",")[0])
         except ValueError:
             return {"error": f"bad volume id {raw!r}"}
-        locs = self.lookup_locations(
-            vid, params.get("collection", [""])[0])
+        locs = self.lookup_locations(vid, collection)
         if not locs:
             return {"volumeId": str(vid), "error": "volume not found"}
         return {"volumeId": str(vid),

@@ -168,6 +168,14 @@ class CommandEnv:
         return nodes
 
     def lookup(self, vid: int, collection: str = "") -> List[str]:
+        from seaweedfs_tpu.wdclient import lookup_cache
+        if lookup_cache.enabled:
+            # shell scripts loop lookups over whole topologies: with
+            # the meta cache armed, concurrent/looped misses coalesce
+            # into batched round trips and repeats answer locally
+            # (errors resolve to [] exactly like the stub path below)
+            return [l.url for l in lookup_cache.for_master(
+                self.master_url, collection).lookup(vid).locations]
         resp = self.master.LookupVolume(master_pb2.LookupVolumeRequest(
             volume_ids=[str(vid)], collection=collection))
         for vl in resp.volume_id_locations:

@@ -555,6 +555,36 @@ LifecyclePassSecondsHistogram = REGISTRY.histogram(
     "wall time of one policy pass including executed transitions",
     buckets=(0.001, 0.01, 0.1, 1, 10, 60, 600, 3600))
 
+# Metadata-plane families (wdclient/lookup_cache.py +
+# filer/listing_cache.py, ISSUE 12): the coalescing vid-lookup cache's
+# ledger and the event-invalidated listing cache's. Labels are bounded
+# enums: lookup `outcome` ∈ hit | negative_hit | miss, listing
+# `outcome` ∈ hit | miss, invalidation `reason` ∈ read_failure |
+# explicit (lookup) / local | peer (listing).
+MetaLookupCounter = REGISTRY.counter(
+    "SeaweedFS_meta_lookup_total",
+    "vid lookups through the coalescing cache by outcome "
+    "(hit | negative_hit | miss)", ("outcome",))
+MetaLookupBatchHistogram = REGISTRY.histogram(
+    "SeaweedFS_meta_lookup_batch_vids",
+    "vids fused into one batched master lookup round trip",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+MetaLookupWaitersCounter = REGISTRY.counter(
+    "SeaweedFS_meta_lookup_singleflight_waiters_total",
+    "lookups that waited on another caller's in-flight fetch "
+    "instead of issuing their own")
+MetaLookupInvalidationsCounter = REGISTRY.counter(
+    "SeaweedFS_meta_lookup_invalidations_total",
+    "cached vid answers dropped by reason", ("reason",))
+MetaListingCounter = REGISTRY.counter(
+    "SeaweedFS_meta_listing_total",
+    "filer directory-listing pages by cache outcome (hit | miss)",
+    ("outcome",))
+MetaListingInvalidationsCounter = REGISTRY.counter(
+    "SeaweedFS_meta_listing_invalidations_total",
+    "listing-cache pages dropped by the metadata event log "
+    "(reason: local | peer)", ("reason",))
+
 # Process self-telemetry: evaluated at scrape time only (callable
 # gauges), so every bench gets RSS/fd/thread/GC correlation for free.
 ProcessRSSGauge = REGISTRY.gauge(

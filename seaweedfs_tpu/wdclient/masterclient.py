@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import grpc
 
@@ -57,6 +57,13 @@ class MasterClient:
         self._thread: Optional[threading.Thread] = None
         self._stream = None
         self._dialed = False
+        # coalescing single-flight + TTL cache over the miss path
+        # (-meta.lookupTTL, ISSUE 12): ABSENT — not merely empty —
+        # unless enabled, so the disabled miss path is one None check.
+        # The KeepConnected-fed vid_map stays the first stop either way.
+        from seaweedfs_tpu.wdclient import lookup_cache as _lc
+        self._lookup_cache = _lc.make_cache(self._lookup_batch) \
+            if _lc.enabled else None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -165,6 +172,9 @@ class MasterClient:
         locs = self.vid_map.lookup(vid)
         if locs:
             return locs
+        if self._lookup_cache is not None:
+            # coalesced + single-flighted + TTL'd (incl. negative)
+            return list(self._lookup_cache.lookup(vid).locations)
         # cache miss: ask the master directly and backfill
         try:
             resp = master_stub(self.current_master).LookupVolume(
@@ -175,6 +185,66 @@ class MasterClient:
             for l in vl.locations:
                 self.vid_map.add_location(vid, Location(l.url, l.public_url))
         return self.vid_map.lookup(vid)
+
+    @property
+    def lookup_cache_enabled(self) -> bool:
+        """True when the coalescing cache is armed — the one check
+        callers pay before batch-prefetching (disabled: no prefetch,
+        the lazy per-chunk path is byte-identical to the old one)."""
+        return self._lookup_cache is not None
+
+    def lookup_many(self, vids) -> Dict[int, List[Location]]:
+        """Resolve many vids at once: stream-fed vid_map hits answer
+        locally, every miss rides ONE batched LookupVolume through the
+        coalescing cache — a 64-chunk read's locations in one master
+        round trip. Without the cache (disabled) this is exactly a
+        loop over lookup(), so behavior off is unchanged."""
+        out: Dict[int, List[Location]] = {}
+        misses: List[int] = []
+        for vid in dict.fromkeys(vids):
+            locs = self.vid_map.lookup(vid)
+            if locs:
+                out[vid] = locs
+            else:
+                misses.append(vid)
+        if not misses:
+            return out
+        if self._lookup_cache is not None:
+            for vid, res in self._lookup_cache.lookup_many(misses).items():
+                out[vid] = list(res.locations)
+        else:
+            for vid in misses:
+                out[vid] = self.lookup(vid)
+        return out
+
+    def invalidate_lookup(self, vid: int,
+                          reason: str = "read_failure") -> None:
+        """A caller failed to read from every location lookup()
+        returned: drop the cached belief so the next lookup re-asks."""
+        if self._lookup_cache is not None:
+            self._lookup_cache.invalidate(vid, reason)
+
+    def _lookup_batch(self, vids: List[int]):
+        """Batched LookupVolume against the current master — the
+        coalescing cache's gRPC transport. Raises on transport failure
+        (the cache answers waiters and caches nothing)."""
+        from seaweedfs_tpu.wdclient.lookup_cache import LookupResult
+        resp = master_stub(self.current_master).LookupVolume(
+            master_pb2.LookupVolumeRequest(
+                volume_ids=[str(v) for v in vids]))
+        out: Dict[int, LookupResult] = {}
+        for vl in resp.volume_id_locations:
+            try:
+                vid = int(vl.volume_id.split(",")[0])
+            except ValueError:
+                continue
+            if vl.error:
+                out[vid] = LookupResult((), vl.error)
+            else:
+                out[vid] = LookupResult(tuple(
+                    Location(l.url, l.public_url or l.url)
+                    for l in vl.locations), "")
+        return out
 
     def lookup_file_id(self, fid: str) -> str:
         from seaweedfs_tpu.operation.file_id import parse_fid

@@ -1,0 +1,434 @@
+"""Coalescing vid-lookup cache (ISSUE 12): single-flight, coalesced
+batching, TTL (positive + negative), invalidation, transport-failure
+semantics, the batched master lookup surfaces on both transports, and
+the schedule-explorer pass over the single-flight/coalesce machine.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.wdclient import lookup_cache as lc
+from seaweedfs_tpu.wdclient.vid_map import Location
+
+
+@pytest.fixture(autouse=True)
+def _reset_module():
+    yield
+    lc.reset()
+
+
+def _fetcher(log, missing=(), fail=False, gate=None):
+    def fetch(vids):
+        log.append(list(vids))
+        if gate is not None:
+            gate.wait(2.0)
+        if fail:
+            raise OSError("master unreachable")
+        out = {}
+        for v in vids:
+            if v in missing:
+                out[v] = lc.LookupResult((), f"volume {v} not found")
+            else:
+                out[v] = lc.LookupResult(
+                    (Location(f"u{v}", f"p{v}"),), "")
+        return out
+    return fetch
+
+
+def test_batch_hit_negative_and_invalidate():
+    calls = []
+    c = lc.CoalescingLookupCache(_fetcher(calls, missing={9}),
+                                 coalesce_s=0)
+    res = c.lookup_many([1, 2, 9, 2, 1])
+    assert calls == [[1, 2, 9]], "dups fold, one batched trip"
+    assert res[1].locations[0].url == "u1"
+    assert res[9].error and not res[9].locations
+    # positive AND negative answers serve from cache
+    assert c.lookup(1).locations and c.lookup(9).error
+    assert calls == [[1, 2, 9]]
+    st = c.stats()
+    assert st["hits"] == 1 and st["negative_hits"] == 1
+    # invalidation drops exactly the one vid
+    assert c.invalidate(1) and not c.invalidate(1)
+    c.lookup(1)
+    assert calls == [[1, 2, 9], [1]]
+    assert c.lookup(2).locations and len(calls) == 2
+
+
+def test_ttl_expiry_positive_and_negative():
+    calls = []
+    c = lc.CoalescingLookupCache(_fetcher(calls, missing={9}),
+                                 ttl_s=30.0, negative_ttl_s=0.05,
+                                 coalesce_s=0)
+    c.lookup_many([1, 9])
+    time.sleep(0.08)
+    # negative expired -> refetched; positive still cached
+    assert c.lookup(9).error and calls == [[1, 9], [9]]
+    assert c.lookup(1).locations and len(calls) == 2
+
+
+def test_batch_max_splits_round_trips():
+    calls = []
+    c = lc.CoalescingLookupCache(_fetcher(calls), coalesce_s=0,
+                                 batch_max=4)
+    res = c.lookup_many(range(10))
+    assert len(res) == 10 and all(r.locations for r in res.values())
+    assert [len(b) for b in calls] == [4, 4, 2]
+
+
+def test_transport_failure_answers_waiters_and_caches_nothing():
+    calls = []
+    fail = {"on": True}
+
+    def fetch(vids):
+        calls.append(list(vids))
+        if fail["on"]:
+            raise OSError("blip")
+        return {v: lc.LookupResult((Location("u", "u"),), "")
+                for v in vids}
+
+    c = lc.CoalescingLookupCache(fetch, coalesce_s=0)
+    res = c.lookup(5)
+    assert "blip" in res.error
+    fail["on"] = False
+    # nothing was cached: the next call retries the master and wins
+    assert c.lookup(5).locations and len(calls) == 2
+    assert c.stats()["entries"] == 1
+
+
+def test_fetch_missing_vid_is_not_found_not_keyerror():
+    # a transport that omits a requested vid (buggy/old master) must
+    # still answer that vid's flight
+    c = lc.CoalescingLookupCache(lambda vids: {}, coalesce_s=0)
+    res = c.lookup(3)
+    assert "not found" in res.error
+
+
+def test_http_fetch_many_never_negative_caches_master_errors(
+        monkeypatch):
+    """Review finding: a 503 (leader election), a top-level
+    {"error": ...} body, or a legacy single-vid answer to a MULTI-vid
+    batch carry no per-vid answers — they must raise (transport-class
+    failure, nothing cached), never map to 'volume not found'."""
+    from seaweedfs_tpu.util import http_client
+
+    class _R:
+        def __init__(self, status, body):
+            self.status = status
+            self.body = json.dumps(body).encode()
+
+    replies = []
+    monkeypatch.setattr(http_client, "request",
+                        lambda *a, **k: replies.pop(0))
+
+    replies.append(_R(503, {"error": "no raft leader elected yet"}))
+    with pytest.raises(IOError):
+        lc.http_fetch_many("m:1", [1, 2])
+
+    replies.append(_R(200, {"error": "something else broke"}))
+    with pytest.raises(IOError):
+        lc.http_fetch_many("m:1", [1, 2])
+
+    # legacy single-vid shape answering a multi-vid batch: the other
+    # vids have NO answer — raising beats negative-caching them
+    replies.append(_R(200, {"volumeId": "1", "locations":
+                            [{"url": "u", "publicUrl": "p"}]}))
+    with pytest.raises(IOError):
+        lc.http_fetch_many("m:1", [1, 2])
+
+    # ...but the same legacy shape for a single-vid ask is fine
+    replies.append(_R(200, {"volumeId": "1", "locations":
+                            [{"url": "u", "publicUrl": "p"}]}))
+    res = lc.http_fetch_many("m:1", [1])
+    assert res[1].locations[0].url == "u"
+
+    # and through the cache: the failure answers the caller with the
+    # error but caches NOTHING — recovery is immediate
+    replies.append(_R(503, {"error": "no raft leader elected yet"}))
+    replies.append(_R(200, {"volumeIdLocations": [
+        {"volumeId": "5", "locations": [{"url": "u5"}]}]}))
+    c = lc.CoalescingLookupCache(
+        lambda vids: lc.http_fetch_many("m:1", vids), coalesce_s=0)
+    assert "503" in c.lookup(5).error
+    assert c.lookup(5).locations[0].url == "u5", \
+        "a master blip must not shadow the recovered answer"
+
+
+def test_single_flight_one_rpc_many_waiters():
+    calls = []
+    gate = threading.Event()
+    c = lc.CoalescingLookupCache(_fetcher(calls, gate=gate),
+                                 coalesce_s=0.05)
+    out = []
+    ts = [threading.Thread(target=lambda: out.append(c.lookup(7)))
+          for _ in range(6)]
+    for t in ts:
+        t.start()
+    time.sleep(0.15)
+    gate.set()
+    for t in ts:
+        t.join(5)
+    assert len(calls) == 1, "concurrent misses must share one flight"
+    assert len(out) == 6 and all(r.locations for r in out)
+
+
+def test_coalescing_window_fuses_distinct_vids():
+    # a third caller parked mid-fetch keeps the active count > 1, so
+    # the window leader deterministically sleeps out its window (a
+    # LONE leader skips it — see the lone-caller test below)
+    calls = []
+    gate = threading.Event()
+    parked = threading.Event()
+
+    def fetch(vids):
+        calls.append(list(vids))
+        if 99 in vids:
+            parked.set()
+            gate.wait(2.0)
+        return {v: lc.LookupResult((Location(f"u{v}", f"p{v}"),), "")
+                for v in vids}
+
+    c = lc.CoalescingLookupCache(fetch, coalesce_s=0.2)
+    t99 = threading.Thread(target=lambda: c.lookup(99))
+    t99.start()
+    assert parked.wait(2.0)
+    done = threading.Barrier(3)
+
+    def one(vid):
+        done.wait(2.0)   # release together: both inside one window
+        c.lookup(vid)
+
+    ts = [threading.Thread(target=one, args=(v,)) for v in (1, 2)]
+    for t in ts:
+        t.start()
+    done.wait(2.0)
+    for t in ts:
+        t.join(5)
+    gate.set()
+    t99.join(5)
+    fused = sorted(v for b in calls if 99 not in b for v in b)
+    assert fused == [1, 2]
+    assert len(calls) == 2, \
+        f"misses inside one window must fuse: {calls}"
+
+
+def test_lone_caller_skips_coalesce_window():
+    """Review finding: a lone sequential caller has nothing to
+    coalesce with — it must NOT sleep out the window (a shell loop
+    over 10k vids would pay 10k windows of pure latency). The window
+    here is 5s: paying it even once trips the deadline."""
+    calls = []
+    c = lc.CoalescingLookupCache(_fetcher(calls), coalesce_s=5.0)
+    t0 = time.monotonic()
+    for vid in (1, 2, 3):
+        assert c.lookup(vid).locations
+    assert c.lookup_many([4, 5, 6])[5].locations
+    assert time.monotonic() - t0 < 2.0, \
+        "lone misses must resolve without sleeping the window"
+    assert [sorted(b) for b in calls] == [[1], [2], [3], [4, 5, 6]]
+
+
+def test_env_sibling_tunables_tolerate_garbage(monkeypatch):
+    """Review finding: _env_configure runs at import in every server
+    and tool — a malformed SIBLING tunable must fall back to its
+    default, never crash the process."""
+    monkeypatch.setenv("SEAWEED_META_LOOKUP_TTL_S", "30")
+    monkeypatch.setenv("SEAWEED_META_NEGATIVE_TTL_S", "oops")
+    monkeypatch.setenv("SEAWEED_META_COALESCE_MS", "2ms")
+    monkeypatch.setenv("SEAWEED_META_BATCH_MAX", "64.5")
+    lc._env_configure()   # must not raise
+    assert lc.enabled and lc._ttl_s == 30.0
+    assert lc._negative_ttl_s == lc.DEFAULT_NEGATIVE_TTL_S
+    assert lc._coalesce_s == lc.DEFAULT_COALESCE_MS / 1000.0
+    assert lc._batch_max == lc.DEFAULT_BATCH_MAX
+
+
+def test_module_seam_configure_reset_and_for_master():
+    assert not lc.enabled
+    lc.configure(enable=True, ttl_s=10.0)
+    assert lc.enabled
+    a = lc.for_master("127.0.0.1:1")
+    assert lc.for_master("127.0.0.1:1") is a, "per-master singleton"
+    assert lc.for_master("127.0.0.1:1", "col") is not a
+    lc.configure(enable=True, ttl_s=0)
+    assert not lc.enabled, "ttl 0 means off"
+    lc.reset()
+    assert not lc.enabled
+
+
+def test_module_invalidate_spans_collections():
+    lc.configure(enable=True, ttl_s=10.0)
+    calls = []
+    for coll in ("", "col"):
+        c = lc.for_master("m:1", coll)
+        c._fetch_many = _fetcher(calls)   # no real master in this test
+        c.lookup(4)
+    assert len(calls) == 2
+    lc.invalidate("m:1", 4)
+    for coll in ("", "col"):
+        lc.for_master("m:1", coll).lookup(4)
+    assert len(calls) == 4, "both collection views must re-ask"
+
+
+def test_explorer_single_flight_and_coalesce_interleavings():
+    """The single-flight/coalesce handoff under seeded deterministic
+    interleavings (PR 10 explorer): whatever the schedule, every
+    caller gets a correct answer, no vid is fetched after it is
+    cached, and flights never leak."""
+    from seaweedfs_tpu.util.scheduler import explore
+
+    def scenario():
+        calls = []
+        c = lc.CoalescingLookupCache(_fetcher(calls, missing={3}),
+                                     coalesce_s=0.01)
+        results = {}
+        res_lock = threading.Lock()
+
+        def reader(name, vids):
+            got = c.lookup_many(vids)
+            with res_lock:
+                results[name] = got
+
+        ts = [threading.Thread(target=reader, args=("a", [1, 2])),
+              threading.Thread(target=reader, args=("b", [2, 3])),
+              threading.Thread(target=reader, args=("c", [1, 3]))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results["a"][1].locations[0].url == "u1"
+        assert results["a"][2].locations and results["b"][2].locations
+        assert results["b"][3].error and results["c"][3].error
+        # one fetch per vid at most (single-flight may batch them in
+        # any window split, but never refetches a resolved vid)
+        fetched = [v for b in calls for v in b]
+        assert sorted(set(fetched)) == sorted(fetched), \
+            f"vid fetched twice: {calls}"
+        assert not c._flights, "flights must drain"
+
+    res = explore(scenario, schedules=20, seed=0)
+    assert res.ok and res.schedules == 20
+
+
+# -- the batched master lookup surfaces (HTTP + gRPC + operations) ------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from tests.cluster_util import Cluster
+    c = Cluster(tmp_path_factory.mktemp("meta"), n_volume_servers=1)
+    vs = c.volume_servers[0]
+    for vid in (71, 72):
+        vs.store.add_volume(vid)
+    vs.trigger_heartbeat()
+    c.wait_for(lambda: all(c.master.topo.lookup(v) for v in (71, 72)),
+               what="volume registration")
+    yield c
+    c.stop()
+
+
+def test_http_batched_lookup_and_legacy_parity(cluster):
+    with cluster.http(f"{cluster.master.url}/dir/lookup"
+                      "?volumeIds=71,72,9999,junk") as r:
+        out = json.load(r)
+    by_vid = {e["volumeId"]: e for e in out["volumeIdLocations"]}
+    assert by_vid["71"]["locations"] and by_vid["72"]["locations"]
+    assert "error" in by_vid["9999"] and "error" in by_vid["junk"]
+    # legacy single-vid param answers the reference shape unchanged
+    with cluster.http(f"{cluster.master.url}/dir/lookup"
+                      "?volumeId=71") as r:
+        legacy = json.load(r)
+    assert legacy["volumeId"] == "71" and legacy["locations"]
+    assert "volumeIdLocations" not in legacy
+    # and the batched entry for the same vid carries the same locations
+    assert by_vid["71"]["locations"] == legacy["locations"]
+
+
+def test_grpc_lookup_many_vids_per_entry_errors(cluster):
+    from seaweedfs_tpu.pb import master_pb2, master_stub
+    resp = master_stub(cluster.master.url).LookupVolume(
+        master_pb2.LookupVolumeRequest(
+            volume_ids=["71", "9999", "72"]))
+    got = {vl.volume_id: vl for vl in resp.volume_id_locations}
+    assert got["71"].locations and got["72"].locations
+    assert got["9999"].error and not got["9999"].locations
+
+
+def test_operations_lookup_many_one_round_trip(cluster):
+    from seaweedfs_tpu.operation import operations
+    # disabled: parity with the per-vid path, no cache constructed
+    plain = operations.lookup_many(cluster.master.url, [71, 72, 9999])
+    assert plain[71] and plain[72] and plain[9999] == []
+    assert not lc._caches
+    lc.configure(enable=True, ttl_s=10.0, coalesce_ms=0.0)
+    try:
+        batched = operations.lookup_many(cluster.master.url,
+                                         [71, 72, 9999])
+        assert batched == plain, "batched answers must be identical"
+        cache = lc.for_master(cluster.master.url)
+        st = cache.stats()
+        assert st["misses"] == 3 and st["entries"] == 3
+        # the whole set again: pure hits, no new round trip
+        assert operations.lookup_many(cluster.master.url,
+                                      [71, 72, 9999]) == plain
+        st = cache.stats()
+        assert st["hits"] == 2 and st["negative_hits"] == 1
+        # negative caching: repeated misses on a deleted volume serve
+        # from cache instead of hammering the master
+        with pytest.raises(RuntimeError):
+            operations.lookup(cluster.master.url, 9999)
+        assert cache.stats()["negative_hits"] == 2
+        # read-failure invalidation drops the entry for re-ask
+        lc.invalidate(cluster.master.url, 71)
+        assert cache.stats()["entries"] == 2
+    finally:
+        lc.reset()
+
+
+def test_shell_env_lookup_through_cache(cluster):
+    from seaweedfs_tpu.shell.command_env import CommandEnv
+    env = CommandEnv(cluster.master.url)
+    plain = env.lookup(71)
+    assert plain and env.lookup(9999) == []
+    lc.configure(enable=True, ttl_s=10.0, coalesce_ms=0.0)
+    try:
+        assert env.lookup(71) == plain
+        assert env.lookup(9999) == []
+        st = lc.for_master(cluster.master.url).stats()
+        assert st["misses"] == 2
+        env.lookup(71)
+        assert lc.for_master(cluster.master.url).stats()["hits"] == 1
+    finally:
+        lc.reset()
+
+
+def test_masterclient_lookup_many_batches_misses(cluster):
+    from seaweedfs_tpu.wdclient.masterclient import MasterClient
+    lc.configure(enable=True, ttl_s=10.0, coalesce_ms=0.0)
+    try:
+        mc = MasterClient([cluster.master.url], client_name="test")
+        assert mc.lookup_cache_enabled
+        got = mc.lookup_many([71, 72, 9999])
+        assert got[71] and got[72] and got[9999] == []
+        assert mc._lookup_cache.stats()["misses"] == 3
+        # hits answer locally; invalidate_lookup drops for re-ask
+        assert mc.lookup(71) == got[71]
+        mc.invalidate_lookup(71)
+        assert mc._lookup_cache.stats()["entries"] == 2
+    finally:
+        lc.reset()
+
+
+def test_masterclient_disabled_is_cacheless(cluster):
+    from seaweedfs_tpu.wdclient.masterclient import MasterClient
+    mc = MasterClient([cluster.master.url], client_name="test2")
+    assert not mc.lookup_cache_enabled
+    assert mc._lookup_cache is None
+    got = mc.lookup_many([71, 9999])
+    assert got[71] and got[9999] == []
+    assert not lc._caches, "disabled path must construct no cache"

@@ -908,3 +908,67 @@ def test_mesh_disabled_overhead(tmp_path):
         "default path must never query jax devices for a mesh"
     assert mesh_fleet._shardings.cache_info().misses == shard_misses, \
         "default path must never build mesh shardings"
+
+
+def test_meta_disabled_overhead(tmp_path):
+    """The metadata plane's caches (ISSUE 12) must be STRICTLY
+    zero-cost while disabled — the house contract.
+
+    Gates. Module: importing wdclient/lookup_cache leaves the seam
+    disabled with NO cache constructed anywhere (env-armed runs are
+    skipped, mirroring the scheduler gate). Construction: a default
+    FilerServer (no -meta.*) carries listing_cache=None, an unhooked
+    event log (on_append is None), and a cacheless MasterClient — the
+    wired call sites are each ONE None/flag check. Behavior: the
+    disabled operations.lookup_many is exactly a loop over lookup()
+    and constructs nothing. Threads: none of it spawns any."""
+    import threading
+
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.wdclient import lookup_cache
+    from seaweedfs_tpu.wdclient.masterclient import MasterClient
+
+    if os.environ.get("SEAWEED_META_LOOKUP_TTL_S"):
+        pytest.skip("suite runs with the meta cache armed by request")
+
+    assert not lookup_cache.enabled, \
+        "lookup cache must be disabled without -meta.lookupTTL/env"
+    assert not lookup_cache._caches, \
+        "no process-wide cache may exist while disabled"
+
+    before = {t.name for t in threading.enumerate()}
+
+    fs = FilerServer(master_url="127.0.0.1:1", port=18996)
+    try:
+        assert fs.listing_cache is None, \
+            "default filer must not construct a listing cache"
+        assert fs.filer.listing_cache is None
+        assert fs.filer.meta_log.on_append is None, \
+            "default event log must not carry an invalidation hook"
+        assert fs.master_client._lookup_cache is None
+        assert fs.master_client.lookup_cache_enabled is False
+
+        # the disabled list path is the pre-ISSUE-12 store walk
+        from seaweedfs_tpu.filer.filer import new_entry
+        fs.filer.create_entry("/gate", new_entry("x"))
+        assert [e.name for e in fs.filer.list_entries("/gate")] == ["x"]
+        assert fs.listing_cache is None and not lookup_cache._caches
+    finally:
+        fs.filer.close()
+
+    # constructing the caches directly spawns nothing either (they are
+    # pure data structures; the batch leader runs on caller threads)
+    from seaweedfs_tpu.filer.listing_cache import ListingCache
+    lc = ListingCache(1 << 20)
+    cc = lookup_cache.CoalescingLookupCache(lambda vids: {},
+                                            coalesce_s=0)
+    del lc, cc
+    mc = MasterClient(["127.0.0.1:1"], client_name="gate")
+    assert mc._lookup_cache is None
+
+    after = {t.name for t in threading.enumerate()}
+    # the event log's lazily-spawned flusher belongs to the
+    # pre-existing append machinery (the create_entry above), not to
+    # the meta plane; nothing ELSE may have appeared
+    grown = after - before - {"log-buffer-flush"}
+    assert len(grown) == 0, f"disabled meta plane spawned {grown}"
