@@ -1094,6 +1094,170 @@ def meta_plane_sweep(fanouts=(64, 512), reader_counts=(1, 8)) -> dict:
     return out
 
 
+def _serve_pump(port: int, fid: str, n_conns: int, seconds: float,
+                expect_bytes: int) -> dict:
+    """Single-threaded selector client: n_conns keep-alive
+    connections each issue GET /fid, read the full response, repeat.
+    One thread drives all of them, so at 256 connections the CLIENT
+    is not the thing being measured. Returns reqs + errors."""
+    import selectors
+    import socket
+
+    req = (f"GET /{fid} HTTP/1.1\r\nHost: b\r\n\r\n").encode()
+    sel = selectors.DefaultSelector()
+
+    class C:
+        __slots__ = ("sock", "buf", "need", "reqs")
+
+        def __init__(self):
+            self.sock = socket.create_connection(("127.0.0.1", port))
+            self.sock.setblocking(False)
+            self.buf = bytearray()
+            self.need = -1
+            self.reqs = 0
+
+    conns = []
+    for _ in range(n_conns):
+        c = C()
+        conns.append(c)
+        sel.register(c.sock, selectors.EVENT_READ, c)
+        try:
+            c.sock.sendall(req)
+        except BlockingIOError:
+            pass
+    done = 0
+    errors = 0
+    t0 = time.perf_counter()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        for key, _mask in sel.select(0.1):
+            c = key.data
+            try:
+                data = c.sock.recv(1 << 16)
+            except BlockingIOError:
+                continue
+            except OSError:
+                errors += 1
+                sel.unregister(c.sock)
+                continue
+            if not data:
+                errors += 1
+                sel.unregister(c.sock)
+                continue
+            c.buf += data
+            if c.need < 0:
+                end = c.buf.find(b"\r\n\r\n")
+                if end < 0:
+                    continue
+                head = bytes(c.buf[:end]).lower()
+                i = head.find(b"content-length:")
+                j = head.find(b"\r", i)
+                clen = int(head[i + 15:j if j > 0 else len(head)])
+                c.need = end + 4 + clen
+            if len(c.buf) >= c.need:
+                del c.buf[:c.need]
+                c.need = -1
+                c.reqs += 1
+                done += 1
+                try:
+                    c.sock.sendall(req)
+                except OSError:
+                    errors += 1
+                    sel.unregister(c.sock)
+    wall = time.perf_counter() - t0
+    for c in conns:
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+    sel.close()
+    return {"reqs": done, "wall_s": round(wall, 3),
+            "rps": round(done / wall, 1),
+            "mb_s": round(done * expect_bytes / wall / 1e6, 1),
+            "errors": errors,
+            "active_conns": len([c for c in conns if c.reqs > 0])}
+
+
+def serve_async_sweep(seconds: float = 3.0, rounds: int = 3) -> dict:
+    """--serve mode: threaded vs async serving core on a REAL volume
+    server subprocess (ISSUE 13). Three workloads per model: small-GET
+    throughput at 8 keep-alive connections, 1MB-GET throughput at 4
+    (the zero-copy sendfile path), and keep-alive SCALING at 256
+    connections — the regime where thread-per-connection parks 256
+    threads and the selector loop parks none. Best-of-N alternated
+    (shared-VM timing discipline)."""
+    import urllib.request
+
+    results = {"metric": "serve_async", "unit": "req/s",
+               "seconds_per_round": seconds, "rounds": rounds}
+    blobs = {}
+
+    def boot(model):
+        mport, vport = _free_port(), _free_port()
+        extra = ["-serve.async"] if model == "async" else []
+        m = _spawn_server("master", "-port", str(mport),
+                          "-volumeSizeLimitMB", "256")
+        v = _spawn_server("volume", "-port", str(vport),
+                          "-dir", f"/tmp/bench-serve-{model}-{vport}",
+                          "-mserver", f"127.0.0.1:{mport}",
+                          "-max", "8", *extra)
+        _wait_http(f"http://127.0.0.1:{mport}/dir/status")
+        _wait_http(f"http://127.0.0.1:{vport}/status")
+        for name, size in (("small", 4096), ("large", 1 << 20)):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/dir/assign") as r:
+                a = json.load(r)
+            body = os.urandom(size)
+            bnd = "b0und"
+            payload = ((f"--{bnd}\r\nContent-Disposition: form-data;"
+                        f' name="file"; filename="{name}"\r\n\r\n')
+                       .encode() + body +
+                       f"\r\n--{bnd}--\r\n".encode())
+            rq = urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", data=payload,
+                method="POST",
+                headers={"Content-Type":
+                         f"multipart/form-data; boundary={bnd}"})
+            with urllib.request.urlopen(rq):
+                pass
+            blobs[name] = (a["fid"], size)
+        return m, v, vport
+
+    workloads = (("small_get_c8", "small", 8),
+                 ("large_get_c4", "large", 4),
+                 ("scale_c256", "small", 256))
+    best = {model: {w: None for w, _, _ in workloads}
+            for model in ("threaded", "async")}
+    for rnd in range(rounds):
+        order = ("threaded", "async") if rnd % 2 == 0 \
+            else ("async", "threaded")
+        for model in order:
+            m = v = None
+            try:
+                m, v, vport = boot(model)
+                for wname, blob, conns in workloads:
+                    fid, size = blobs[blob]
+                    line = _serve_pump(vport, fid, conns, seconds,
+                                       size)
+                    prev = best[model][wname]
+                    if prev is None or line["rps"] > prev["rps"]:
+                        best[model][wname] = line
+            finally:
+                for proc in (v, m):
+                    if proc is not None:
+                        proc.terminate()
+                for proc in (v, m):
+                    if proc is not None:
+                        proc.wait(timeout=10)
+    results["threaded"] = best["threaded"]
+    results["async"] = best["async"]
+    results["speedup"] = {
+        w: round(best["async"][w]["rps"] /
+                 max(best["threaded"][w]["rps"], 1e-9), 3)
+        for w, _, _ in workloads}
+    return results
+
+
 def chaos_sweep() -> dict:
     """Resilience scenario sweep (ISSUE 6 satellite): an in-process
     master + 3 volume servers take concurrent reads while the sweep
@@ -1561,6 +1725,15 @@ def main() -> None:
         # kernel headline
         line = meta_plane_sweep()
         with open(os.path.join(REPO_ROOT, "BENCH_META.json"),
+                  "w") as f:
+            json.dump(line, f, indent=1)
+        print(json.dumps(line), flush=True)
+        return
+    if "--serve" in sys.argv:
+        # serve mode is host-pipeline only: threaded vs async serving
+        # core on real subprocess servers, not the kernel headline
+        line = serve_async_sweep()
+        with open(os.path.join(REPO_ROOT, "BENCH_SERVE.json"),
                   "w") as f:
             json.dump(line, f, indent=1)
         print(json.dumps(line), flush=True)

@@ -88,6 +88,7 @@ def _master_parser() -> argparse.ArgumentParser:
                    default=0.0,
                    help="IO budget handed to each scheduled scrub")
     _add_lifecycle_args(p)
+    _add_serve_args(p)
     p.add_argument("-cpuprofile", default=None)
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
@@ -202,6 +203,7 @@ def _build_master(opts):
         sequencer_node_id=conf.get("master.sequencer.node_id"),
         sequencer_etcd_urls=conf.get_string(
             "master.sequencer.sequencer_etcd_urls", "127.0.0.1:2379"),
+        serve=_serve_config(opts),
     )
 
 
@@ -306,7 +308,56 @@ def _volume_parser() -> argparse.ArgumentParser:
                    default=0, help="Prometheus /metrics pull port")
     _add_resilience_args(p)
     _add_trace_args(p)
+    _add_serve_args(p)
     return p
+
+
+def _add_serve_args(p: argparse.ArgumentParser) -> None:
+    """Shared -serve.* flags (every HTTP role; util/async_server.py).
+    Off by default — the threaded model serves and no async machinery
+    is ever constructed."""
+    p.add_argument("-serve.async", dest="serve_async",
+                   action="store_true",
+                   help="serve HTTP on the selector event loop (one "
+                        "poll loop + a bounded worker pool) instead "
+                        "of a thread per connection; responses are "
+                        "byte-identical, GET payloads ride zero-copy "
+                        "os.sendfile")
+    p.add_argument("-serve.maxConns", dest="serve_max_conns",
+                   type=int, default=0,
+                   help="open-connection cap for -serve.async; past "
+                        "it the listener stops accepting until "
+                        "connections close (0 = built-in 4096)")
+    p.add_argument("-serve.keepAliveBudget",
+                   dest="serve_keepalive_budget", type=int, default=0,
+                   help="idle keep-alive connections retained by "
+                        "-serve.async; past it the least-recently-"
+                        "active idle connection is closed (0 = "
+                        "built-in 1024)")
+    p.add_argument("-serve.workers", dest="serve_workers", type=int,
+                   default=0,
+                   help="handler worker threads for -serve.async "
+                        "(spawned lazily on the first requests; 0 = "
+                        "built-in 16)")
+    p.add_argument("-serve.sendfile", dest="serve_sendfile",
+                   type=lambda s: s.lower() not in ("0", "false", "no"),
+                   default=True,
+                   help="zero-copy GET payloads via os.sendfile under "
+                        "-serve.async (false = copy through userspace; "
+                        "payload CRC-on-read semantics like the "
+                        "threaded model)")
+
+
+def _serve_config(opts):
+    """ServeConfig from the -serve.* flags; None stays the threaded
+    default without importing anything."""
+    from seaweedfs_tpu.util.http_server import ServeConfig
+    return ServeConfig(
+        async_mode=getattr(opts, "serve_async", False),
+        max_conns=getattr(opts, "serve_max_conns", 0),
+        keepalive_budget=getattr(opts, "serve_keepalive_budget", 0),
+        workers=getattr(opts, "serve_workers", 0),
+        sendfile=getattr(opts, "serve_sendfile", True))
 
 
 def _add_trace_args(p: argparse.ArgumentParser) -> None:
@@ -418,7 +469,8 @@ def _build_volume(opts):
         ec_mesh=opts.ec_mesh,
         ec_mesh_min_volumes=opts.ec_mesh_min_volumes,
         ec_mesh_bucket_mb=opts.ec_mesh_bucket_mb,
-        ec_mesh_timeout_s=opts.ec_mesh_timeout_s)
+        ec_mesh_timeout_s=opts.ec_mesh_timeout_s,
+        serve=_serve_config(opts))
 
 
 @command("volume", "start a volume server (data plane)")
@@ -498,6 +550,7 @@ def _filer_parser() -> argparse.ArgumentParser:
                    default=0, help="Prometheus /metrics pull port")
     _add_resilience_args(p)
     _add_trace_args(p)
+    _add_serve_args(p)
     return p
 
 
@@ -537,7 +590,8 @@ def _build_filer(opts):
         assign_lease_count=opts.assign_lease_count,
         hedge_reads=opts.resilience_hedge,
         hedge_delay_ms=opts.resilience_hedge_delay_ms,
-        listing_cache_mb=getattr(opts, "meta_listing_cache_mb", 0))
+        listing_cache_mb=getattr(opts, "meta_listing_cache_mb", 0),
+        serve=_serve_config(opts))
     # notification.toml: publish every metadata mutation to the first
     # enabled [notification.X] queue (reference filer.go
     # LoadConfiguration("notification"))
@@ -591,6 +645,7 @@ def _s3_parser() -> argparse.ArgumentParser:
                    help="JSON file with IAM identities")
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
+    _add_serve_args(p)
     return p
 
 
@@ -600,7 +655,8 @@ def run_s3(args) -> int:
     _maybe_start_metrics(opts, role="s3")
     from seaweedfs_tpu.s3api.server import S3ApiServer
     s3 = S3ApiServer(opts.filer, ip=opts.ip, port=opts.port,
-                     iam=_load_iam(opts.config))
+                     iam=_load_iam(opts.config),
+                     serve=_serve_config(opts))
     s3.start()
     return _serve_forever([s3])
 
@@ -611,6 +667,7 @@ def _webdav_parser() -> argparse.ArgumentParser:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-port", type=int, default=7333)
     p.add_argument("-filer", default="127.0.0.1:8888")
+    _add_serve_args(p)
     return p
 
 
@@ -634,7 +691,8 @@ def run_ftp(args) -> int:
 def run_webdav(args) -> int:
     opts = _webdav_parser().parse_args(args)
     from seaweedfs_tpu.server.webdav import WebDavServer
-    wd = WebDavServer(opts.filer, ip=opts.ip, port=opts.port)
+    wd = WebDavServer(opts.filer, ip=opts.ip, port=opts.port,
+                      serve=_serve_config(opts))
     wd.start()
     return _serve_forever([wd])
 

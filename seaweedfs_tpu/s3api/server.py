@@ -14,7 +14,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
+from seaweedfs_tpu.util.http_server import (FastHandler, ServeConfig,
+                                            make_http_server)
 from typing import List, Optional, Tuple
 
 import grpc
@@ -40,8 +41,10 @@ log = wlog.logger("s3")
 
 class S3ApiServer:
     def __init__(self, filer_url: str, ip: str = "127.0.0.1",
-                 port: int = 8333, iam: Optional[Iam] = None):
+                 port: int = 8333, iam: Optional[Iam] = None,
+                 serve: Optional[ServeConfig] = None):
         self.filer_url = filer_url
+        self.serve = serve or ServeConfig()
         self.ip = ip
         self.port = port
         self.iam = iam or Iam()
@@ -57,8 +60,9 @@ class S3ApiServer:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> None:
-        self._http_server = TrackingHTTPServer(
-            (self.ip, self.port), _make_handler(self))
+        self._http_server = make_http_server(
+            (self.ip, self.port), _make_handler(self),
+            role="s3", serve=self.serve)
         # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -244,8 +248,9 @@ def _make_handler(s3: S3ApiServer):
             self._reply(status, _error_xml(code, message, self.path))
 
         def _body(self) -> bytes:
-            n = int(self.headers.get("Content-Length") or 0)
-            return self.rfile.read(n) if n else b""
+            # framing-aware (Content-Length or chunked),
+            # identical on both server models
+            return self.read_body()
 
         def _parse(self):
             u = urllib.parse.urlparse(self.path)

@@ -14,7 +14,8 @@ import json
 import threading
 import time
 import urllib.parse
-from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
+from seaweedfs_tpu.util.http_server import (FastHandler, ServeConfig,
+                                            make_http_server)
 from typing import List, Optional
 
 import grpc
@@ -173,9 +174,11 @@ class FilerServer:
                  assign_lease_count: int = 0,
                  hedge_reads: bool = False,
                  hedge_delay_ms: float = 10.0,
-                 listing_cache_mb: int = 0):
+                 listing_cache_mb: int = 0,
+                 serve: Optional[ServeConfig] = None):
         self.master_url = master_url
         self.ip = ip
+        self.serve = serve or ServeConfig()
         self.port = port
         self.collection = collection
         self.replication = replication
@@ -305,8 +308,9 @@ class FilerServer:
                                       stats_role="filer")
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
-        self._http_server = TrackingHTTPServer(
-            (self.ip, self.port), _make_http_handler(self))
+        self._http_server = make_http_server(
+            (self.ip, self.port), _make_http_handler(self),
+            role="filer", serve=self.serve)
         # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -624,13 +628,28 @@ class FilerServer:
             replication=request.replication or self.replication)
 
     def LookupVolume(self, request, context):
+        """All requested vids resolve in ONE batched master round trip
+        (operations.lookup_many: misses fuse through the coalescing
+        cache when -meta.lookupTTL arms it; disabled it loops the
+        same per-vid RPCs the old code made). Per-vid failures — and
+        unparseable vids — answer as empty location lists, exactly
+        like the old per-vid error handling (ROADMAP item 4
+        residual)."""
         resp = filer_pb2.LookupVolumeResponse()
+        vids = {}
         for vid_s in request.volume_ids:
             try:
-                urls = operations.lookup(self.master_url, int(vid_s))
-            except (RuntimeError, ValueError):
-                urls = []
+                vids[int(vid_s)] = None
+            except ValueError:
+                pass
+        got = operations.lookup_many(self.master_url, list(vids)) \
+            if vids else {}
+        for vid_s in dict.fromkeys(request.volume_ids):
             locs = resp.locations_map[vid_s]
+            try:
+                urls = got.get(int(vid_s), [])
+            except ValueError:
+                urls = []
             for u in urls:
                 locs.locations.add(url=u, public_url=u)
         return resp
@@ -814,8 +833,9 @@ def _make_http_handler(fs: FilerServer):
                     urllib.parse.parse_qs(u.query))
 
         def _body(self) -> bytes:
-            n = int(self.headers.get("Content-Length") or 0)
-            return self.rfile.read(n) if n else b""
+            # framing-aware (Content-Length or chunked), identical on
+            # both server models
+            return self.read_body()
 
         # -- read -------------------------------------------------------------
 

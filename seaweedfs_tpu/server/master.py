@@ -18,7 +18,8 @@ import threading
 import time
 
 import grpc
-from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
+from seaweedfs_tpu.util.http_server import (FastHandler, ServeConfig,
+                                            make_http_server)
 from typing import Dict, List, Optional, Set
 from urllib.parse import parse_qs
 
@@ -97,10 +98,12 @@ class MasterServer:
                  lifecycle: Optional[object] = None,
                  sequencer_type: str = "memory",
                  sequencer_node_id: Optional[int] = None,
-                 sequencer_etcd_urls: str = "127.0.0.1:2379"):
+                 sequencer_etcd_urls: str = "127.0.0.1:2379",
+                 serve: Optional[ServeConfig] = None):
         self.ip = ip
         self.port = port
         self.meta_dir = meta_dir
+        self.serve = serve or ServeConfig()
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         if sequencer_type == "snowflake":
@@ -195,8 +198,9 @@ class MasterServer:
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}",
             [handler, raft_handler])
         self.raft.start()
-        self._http_server = TrackingHTTPServer(
-            (self.ip, self.port), _make_http_handler(self))
+        self._http_server = make_http_server(
+            (self.ip, self.port), _make_http_handler(self),
+            role="master", serve=self.serve)
         # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever, name="master-http",

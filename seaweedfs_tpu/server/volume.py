@@ -27,7 +27,8 @@ from seaweedfs_tpu.resilience import breaker as _breaker
 from seaweedfs_tpu.resilience import deadline as _deadline
 from seaweedfs_tpu.resilience import failpoint as _failpoint
 from seaweedfs_tpu.util import http_client, wlog
-from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
+from seaweedfs_tpu.util.http_server import (FastHandler, ServeConfig,
+                                            make_http_server)
 from seaweedfs_tpu.util.throttler import Throttler
 from seaweedfs_tpu.ec import store_ec
 from seaweedfs_tpu.ec.ec_volume import EcShardNotFound
@@ -91,7 +92,8 @@ class VolumeServer:
                  ec_mesh: bool = False,
                  ec_mesh_min_volumes: int = 0,
                  ec_mesh_bucket_mb: int = 32,
-                 ec_mesh_timeout_s: float = 30.0):
+                 ec_mesh_timeout_s: float = 30.0,
+                 serve: Optional[ServeConfig] = None):
         if storage_backends:
             # cloud-tier targets, e.g. {"s3.default": {...}} (reference
             # master.toml [storage.backend.s3.default])
@@ -182,6 +184,11 @@ class VolumeServer:
         # None check (the lifecycle subsystem's measurement half)
         from seaweedfs_tpu.stats.heat import make_tracker
         self.heat = make_tracker(heat_track, window_s=heat_window_s)
+        # -serve.* config: the async selector core (and its zero-copy
+        # sendfile GET path) only exists when asked for — the default
+        # server never imports util/async_server
+        # (test_perf_gates.test_serve_async_disabled_overhead)
+        self.serve = serve or ServeConfig()
         self._grpc_server = None
         self._http_server = None
         self._http_thread = None
@@ -201,8 +208,9 @@ class VolumeServer:
             volume_server_pb2, "VolumeServer", self)
         self._grpc_server = rpc.make_server(
             f"{self.ip}:{self.port + rpc.GRPC_PORT_OFFSET}", [handler])
-        self._http_server = TrackingHTTPServer(
-            (self.ip, self.port), _make_http_handler(self))
+        self._http_server = make_http_server(
+            (self.ip, self.port), _make_http_handler(self),
+            role="volume", serve=self.serve)
         # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -996,11 +1004,14 @@ class VolumeServer:
 
     # -- needle data ops (shared by HTTP and gRPC paths) -----------------------
 
-    def _read_needle(self, vid: int, n: Needle) -> Needle:
-        if self.heat is not None:
+    def _read_needle(self, vid: int, n: Needle,
+                     record_heat: bool = True) -> Needle:
+        if self.heat is not None and record_heat:
             # counted at admission, not success: a read of a dead
             # needle still heats the volume (the lifecycle policy cares
-            # about demand, not hit rate)
+            # about demand, not hit rate). record_heat=False when the
+            # async span fast path already counted this request's
+            # admission and fell back here for the payload.
             self.heat.record(vid, n.id)
         if self.store.has_volume(vid):
             got = self.store.read_needle(vid, n)
@@ -1328,8 +1339,9 @@ def _make_http_handler(vs: VolumeServer):
                             ctype="application/json")
 
         def _body(self) -> bytes:
-            length = int(self.headers.get("content-length") or 0)
-            return self.rfile.read(length) if length else b""
+            # framing-aware (Content-Length or chunked), identical on
+            # both server models
+            return self.read_body()
 
         def _parse_path(self):
             """/<vid>,<key_hex><cookie_hex> with optional leading dirs.
@@ -1389,8 +1401,23 @@ def _make_http_handler(vs: VolumeServer):
                     vs.store.find_ec_volume(f.volume_id) is None:
                 self._redirect_to_replica(f)
                 return
+            record_heat = True
+            if self.async_conn is not None and vs.serve.sendfile and \
+                    not _failpoint._armed and \
+                    vs.store.has_volume(f.volume_id):
+                # zero-copy fast path: payload rides os.sendfile from
+                # the volume fd straight to the socket. Falls back to
+                # the byte path whenever the payload itself is needed
+                # (compressed, chunk manifest, image resize, armed
+                # failpoints, strict read verification).
+                handled, heat_counted = \
+                    self._try_send_needle_span(f, params)
+                if handled:
+                    return
+                record_heat = not heat_counted
             try:
-                got = vs._read_needle(f.volume_id, n)
+                got = vs._read_needle(f.volume_id, n,
+                                      record_heat=record_heat)
                 # a local read that outlived the client's budget (slow
                 # disk, injected stall) must not get a reply the client
                 # stopped waiting for — 504 via the arm below
@@ -1522,6 +1549,76 @@ def _make_http_handler(vs: VolumeServer):
                             self.path, sent, e)
                 self.close_connection = True
             return True
+
+        def _try_send_needle_span(self, f, params) -> tuple:
+            """Async zero-copy GET: resolve the needle's payload span
+            and reply through send_span (os.sendfile on the async
+            connection). Returns (handled, heat_counted): handled
+            means a response went out; otherwise the caller falls
+            back to the byte path, skipping the heat record iff this
+            attempt already counted the admission. Every reply here
+            mirrors _send_needle/do_GET byte-for-byte."""
+            if vs.heat is not None:
+                # admission, exactly where _read_needle counts it
+                vs.heat.record(f.volume_id, f.key)
+            n = Needle(id=f.key, cookie=f.cookie)
+            try:
+                got_span = vs.store.read_needle_span(f.volume_id, n)
+            except CookieMismatch:
+                self._reply(404)
+                return True, True
+            except NeedleError as e:
+                self._json({"error": str(e)}, code=404)
+                return True, True
+            if got_span is None:
+                return False, True
+            got, span = got_span
+            try:
+                _deadline.check(f"volume {f.volume_id} read")
+            except _deadline.DeadlineExceeded as e:
+                span.close()
+                self._json({"error": str(e)}, code=504)
+                return True, True
+            params = params or {}
+            mime = got.mime.decode("utf-8", "replace") if got.mime \
+                else ""
+            if got.is_compressed or \
+                    (got.is_chunk_manifest and
+                     params.get("cm", [""])[0] != "false") or \
+                    (mime.startswith("image/") and
+                     ("width" in params or "height" in params)):
+                # the payload itself is needed: byte path owns these
+                span.close()
+                return False, True
+            etag = f'"{got.etag}"'
+            if self.headers.get("if-none-match") == etag:
+                span.close()
+                self._reply(304)
+                return True, True
+            headers = {"ETag": etag, "Accept-Ranges": "bytes"}
+            if got.name:
+                headers["Content-Disposition"] = content_disposition(
+                    got.name.decode("utf-8", "replace"))
+            if mime:
+                headers["Content-Type"] = mime
+            rng = self.headers.get("range")
+            if rng and rng.startswith("bytes="):
+                try:
+                    start, end = parse_byte_range(rng, span.length)
+                except ValueError:
+                    span.close()
+                    # RFC 7233 §4.4: 416 carries the representation size
+                    self._reply(416, headers={
+                        "Content-Range": f"bytes */{span.length}"})
+                    return True, True
+                headers["Content-Range"] = \
+                    f"bytes {start}-{end}/{span.length}"
+                span.offset += start
+                span.length = end - start + 1
+                self.send_span(206, span, headers)
+                return True, True
+            self.send_span(200, span, headers)
+            return True, True
 
         def _send_needle(self, got: Needle,
                          params: Optional[dict] = None) -> None:

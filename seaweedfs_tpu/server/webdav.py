@@ -16,7 +16,8 @@ import urllib.error
 import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
-from seaweedfs_tpu.util.http_server import FastHandler, TrackingHTTPServer
+from seaweedfs_tpu.util.http_server import (FastHandler, ServeConfig,
+                                            make_http_server)
 from typing import List, Optional
 
 import grpc
@@ -31,11 +32,13 @@ DAV_NS = "DAV:"
 
 class WebDavServer:
     def __init__(self, filer_url: str, ip: str = "127.0.0.1",
-                 port: int = 7333, root: str = "/"):
+                 port: int = 7333, root: str = "/",
+                 serve: Optional[ServeConfig] = None):
         self.filer_url = filer_url
         self.ip = ip
         self.port = port
         self.root = normalize_path(root)
+        self.serve = serve or ServeConfig()
         self._http_server = None
         self._http_thread = None
 
@@ -44,8 +47,9 @@ class WebDavServer:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> None:
-        self._http_server = TrackingHTTPServer(
-            (self.ip, self.port), _make_handler(self))
+        self._http_server = make_http_server(
+            (self.ip, self.port), _make_handler(self),
+            role="webdav", serve=self.serve)
         # lint: thread-ok(listener thread; ingress wrappers mint request context)
         self._http_thread = threading.Thread(
             target=self._http_server.serve_forever,
@@ -130,8 +134,9 @@ def _make_handler(dav: WebDavServer):
                 self.wfile.write(body)
 
         def _body(self) -> bytes:
-            n = int(self.headers.get("Content-Length") or 0)
-            return self.rfile.read(n) if n else b""
+            # framing-aware (Content-Length or chunked), identical on
+            # both server models
+            return self.read_body()
 
         def _path(self) -> str:
             return urllib.parse.unquote(

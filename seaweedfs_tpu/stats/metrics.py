@@ -451,6 +451,22 @@ HttpPoolReapedCounter = REGISTRY.counter(
     "SeaweedFS_http_pool_reaped_total",
     "pooled connections closed for exceeding the idle age cap")
 
+# Async serving core families (util/async_server.py, -serve.async):
+# how many sockets the selector loop holds, how much GET payload
+# leaves through zero-copy sendfile, and what backpressure sheds.
+# `kind` is bounded: accept (listener paused at -serve.maxConns) |
+# keepalive (idle LRU closed over -serve.keepAliveBudget).
+ServeConnectionsGauge = REGISTRY.gauge(
+    "SeaweedFS_serve_open_connections",
+    "sockets held open by the async serving core", ("role",))
+ServeSendfileBytesCounter = REGISTRY.counter(
+    "SeaweedFS_serve_sendfile_bytes_total",
+    "GET payload bytes sent zero-copy via os.sendfile", ("role",))
+ServeShedCounter = REGISTRY.counter(
+    "SeaweedFS_serve_shed_total",
+    "connections shed by the async core's backpressure",
+    ("role", "kind"))
+
 # Swallowed-error ledger (the `swallow` house rule, ISSUE 8): broad
 # except handlers that deliberately absorb an error must leave a trace
 # — either a log line or this counter. `site` is a short static label

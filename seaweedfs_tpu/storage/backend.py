@@ -126,6 +126,12 @@ class DiskFile(BackendStorageFile):
     def name(self) -> str:
         return self._path
 
+    def fileno(self) -> int:
+        """Raw fd for zero-copy consumers (the volume read path dup()s
+        it into a FileSpan so a concurrent close/compact-swap can't
+        invalidate an in-flight sendfile)."""
+        return self._fd
+
     def close(self) -> None:
         if self._fd >= 0:
             os.close(self._fd)

@@ -17,14 +17,21 @@ per-response Date header (cached per second) are replaced.
 
 from __future__ import annotations
 
+import os
 import socket
 import threading
 import time
+from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 _MAX_LINE = 65536
 _MAX_HEADERS = 100
+# one chunk-size line of a chunked body (hex digits + extensions)
+_MAX_CHUNK_LINE = 1024
+# copy window for threaded file-span bodies (async connections hand
+# the span to os.sendfile instead)
+_SPAN_COPY = 65536
 
 # status -> reason phrase for fast_reply (same table BaseHTTPRequestHandler
 # uses, flattened once at import)
@@ -128,6 +135,162 @@ def http_date() -> str:
     return _date_cache[1]
 
 
+def parse_content_length(headers) -> int:
+    """Declared body length, 0 when absent/unparseable. Shared by both
+    server models so their framing decisions cannot diverge."""
+    try:
+        return int(headers.get("content-length") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def is_chunked(headers) -> bool:
+    return "chunked" in (headers.get("transfer-encoding") or "").lower()
+
+
+class BodyReader:
+    """Framing-aware request-body reader shared by BOTH server models.
+
+    Wraps the raw connection reader (threaded model) or a buffer of the
+    already-received body bytes (async model) and exposes exactly the
+    request body: reads are capped at the Content-Length, and a
+    ``Transfer-Encoding: chunked`` body is decoded transparently —
+    identical decode code on both models, so a chunked PUT answers
+    byte-identically whichever core serves it. ``drain()`` consumes
+    whatever the handler left unread, keeping keep-alive/pipelined
+    framing intact."""
+
+    __slots__ = ("_raw", "_chunked", "_remaining", "_done")
+
+    def __init__(self, raw, headers):
+        self._raw = raw
+        self._chunked = is_chunked(headers)
+        self._remaining = 0 if self._chunked \
+            else parse_content_length(headers)
+        self._done = not self._chunked and self._remaining == 0
+
+    def readable(self) -> bool:
+        return True
+
+    def _next_chunk(self) -> bool:
+        """Advance to the next chunk; False at the terminal chunk."""
+        line = self._raw.readline(_MAX_CHUNK_LINE + 2)
+        if line in (b"\r\n", b"\n"):  # CRLF after the previous chunk
+            line = self._raw.readline(_MAX_CHUNK_LINE + 2)
+        if not line or len(line) > _MAX_CHUNK_LINE:
+            raise ValueError("bad chunk-size line")
+        size_s = line.split(b";", 1)[0].strip()
+        try:
+            size = int(size_s, 16)
+        except ValueError:
+            raise ValueError(f"bad chunk size {size_s[:32]!r}")
+        if size == 0:
+            # trailers run until a blank line (or EOF)
+            while True:
+                t = self._raw.readline(_MAX_LINE + 1)
+                if t in (b"\r\n", b"\n", b""):
+                    break
+            self._done = True
+            return False
+        self._remaining = size
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if self._done:
+            return b""
+        if not self._chunked:
+            want = self._remaining if n is None or n < 0 \
+                else min(n, self._remaining)
+            data = self._raw.read(want) if want else b""
+            self._remaining -= len(data)
+            if self._remaining <= 0 or len(data) < want:
+                self._done = True  # satisfied (or peer hung up early)
+            return data
+        out = []
+        budget = None if n is None or n < 0 else n
+        while not self._done and (budget is None or budget > 0):
+            if self._remaining == 0 and not self._next_chunk():
+                break
+            want = self._remaining if budget is None \
+                else min(budget, self._remaining)
+            data = self._raw.read(want)
+            if len(data) < want:  # peer hung up mid-chunk
+                self._done = True
+            self._remaining -= len(data)
+            out.append(data)
+            if budget is not None:
+                budget -= len(data)
+        return b"".join(out)
+
+    def read_all(self) -> bytes:
+        return self.read(-1)
+
+    def drain(self) -> None:
+        """Discard whatever the handler left unread."""
+        while not self._done:
+            if not self.read(_SPAN_COPY):
+                break
+
+    def close(self) -> None:
+        pass
+
+
+class FileSpan:
+    """A file-backed response body: (fd, offset, length).
+
+    Produced by the volume read path's zero-copy seam
+    (Store.read_needle_span) and consumed by ``send_span``: async
+    connections hand it straight to os.sendfile (payload never enters
+    Python), threaded connections stream it in `_SPAN_COPY` pread
+    windows. Owns its (dup'd) fd; close() exactly once."""
+
+    __slots__ = ("fd", "offset", "length")
+
+    def __init__(self, fd: int, offset: int, length: int):
+        self.fd = fd
+        self.offset = offset
+        self.length = length
+
+    def close(self) -> None:
+        if self.fd >= 0:
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+            self.fd = -1
+
+    def __del__(self):  # leak-proofing; normal paths close explicitly
+        self.close()
+
+
+@dataclass
+class ServeConfig:
+    """-serve.* knobs, one object per server role (0 = built-in
+    default; see util/async_server.py for the defaults)."""
+    async_mode: bool = False
+    max_conns: int = 0
+    keepalive_budget: int = 0
+    workers: int = 0
+    sendfile: bool = True
+
+
+def make_http_server(addr, handler_cls, role: str = "",
+                     serve: Optional[ServeConfig] = None):
+    """The one seam every role builds its data-plane HTTP server
+    through: the selector-based async core under -serve.async, the
+    thread-per-connection TrackingHTTPServer otherwise. The async
+    module is imported ONLY under the flag — a default server
+    constructs no selector, no state-machine objects, no pool
+    (test_perf_gates.test_serve_async_disabled_overhead)."""
+    if serve is not None and serve.async_mode:
+        from seaweedfs_tpu.util.async_server import AsyncHTTPServer
+        return AsyncHTTPServer(addr, handler_cls, role=role,
+                               max_conns=serve.max_conns,
+                               keepalive_budget=serve.keepalive_budget,
+                               workers=serve.workers)
+    return TrackingHTTPServer(addr, handler_cls)
+
+
 class TrackingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that force-closes established connections on
     server_close.
@@ -184,25 +347,30 @@ class FastHandler(BaseHTTPRequestHandler):
     # handler, so buffering coalesces each response into ONE send
     # (Go's net/http response writer buffers the same way).
     wbufsize = 65536
+    # set per-instance by the async core: the _Connection driving this
+    # request, None when the threaded model is serving. Handlers use
+    # it to choose zero-copy paths (volume GET sendfile); everything
+    # else is model-agnostic. One attr read on the hot path when off.
+    async_conn = None
 
     def handle_expect_100(self):
         """The interim 100 Continue must reach the client BEFORE we
-        block reading the body — flush past the buffered wfile."""
+        block reading the body — flush past the buffered wfile. The
+        async core sends the interim reply itself at head-parse time
+        (the body hasn't been received yet when the shim re-parses),
+        so a shim marked _expect_sent skips the write."""
+        if getattr(self, "_expect_sent", False):
+            return True
         ok = super().handle_expect_100()
         if ok:
             self.wfile.flush()
         return ok
 
-    def fast_reply(self, code: int, body: bytes = b"",
-                   headers=None, ctype: str = "") -> None:
-        """Whole response head as one f-string + one buffered write.
-
-        send_response/send_header/end_headers cost ~5 Python calls and
-        a list-append/join per response; at small-file data-plane rates
-        that machinery is a measurable share of the server's cycles.
-        Semantics kept: Date header, Connection: close when the request
-        asked for it, no body on HEAD. (Go's net/http writes its
-        response head the same single-buffer way.)"""
+    def _head_bytes(self, code: int, length: int, headers=None,
+                    ctype: str = "") -> bytes:
+        """One response head as a single bytes blob — shared by
+        fast_reply (in-memory body) and send_span (file-backed body)
+        so the two reply styles cannot diverge on the wire."""
         reason = _REASONS.get(code, "")
         # mirrored by the instrumented send_response hook: the cluster
         # tracer's tail sampler keeps 5xx requests by final status
@@ -215,10 +383,114 @@ class FastHandler(BaseHTTPRequestHandler):
                 parts.append(f"{k}: {v}\r\n")
         if self.close_connection:
             parts.append("Connection: close\r\n")
-        parts.append(f"Content-Length: {len(body)}\r\n\r\n")
-        self.wfile.write("".join(parts).encode("latin-1"))
+        parts.append(f"Content-Length: {length}\r\n\r\n")
+        return "".join(parts).encode("latin-1")
+
+    def fast_reply(self, code: int, body: bytes = b"",
+                   headers=None, ctype: str = "") -> None:
+        """Whole response head as one f-string + one buffered write.
+
+        send_response/send_header/end_headers cost ~5 Python calls and
+        a list-append/join per response; at small-file data-plane rates
+        that machinery is a measurable share of the server's cycles.
+        Semantics kept: Date header, Connection: close when the request
+        asked for it, no body on HEAD. (Go's net/http writes its
+        response head the same single-buffer way.)"""
+        self.wfile.write(self._head_bytes(code, len(body), headers,
+                                          ctype))
         if body and self.command != "HEAD":
             self.wfile.write(body)
+
+    def send_span(self, code: int, span: "FileSpan", headers=None,
+                  ctype: str = "") -> None:
+        """Reply whose body is a FileSpan: identical head bytes to
+        fast_reply, body straight from the file. On an async
+        connection the span rides os.sendfile (zero-copy, the
+        dominant-verb GET path); on a threaded connection it streams
+        in bounded pread windows — byte-identical either way."""
+        self.wfile.write(self._head_bytes(code, span.length, headers,
+                                          ctype))
+        if span.length == 0 or self.command == "HEAD":
+            span.close()
+            return
+        add_span = getattr(self.wfile, "add_span", None)
+        if add_span is not None:  # async response writer
+            add_span(span)
+            return
+        off, remaining = span.offset, span.length
+        try:
+            while remaining > 0:
+                chunk = os.pread(span.fd, min(_SPAN_COPY, remaining),
+                                 off)
+                if not chunk:
+                    raise OSError(
+                        f"file span truncated at {off} "
+                        f"({remaining} bytes short)")
+                self.wfile.write(chunk)
+                off += len(chunk)
+                remaining -= len(chunk)
+        finally:
+            span.close()
+
+    def read_body(self) -> bytes:
+        """The full request body, whatever the framing: the installed
+        BodyReader decodes Content-Length or chunked identically on
+        both server models; bodiless requests read b"" for free."""
+        r = self.rfile
+        if isinstance(r, BodyReader):
+            return r.read_all()
+        n = parse_content_length(self.headers)
+        return r.read(n) if n else b""
+
+    def handle_one_request(self):
+        """Stock dispatch + body framing: a request that declares a
+        body gets a BodyReader installed as self.rfile for the
+        handler's duration, and whatever the handler leaves unread is
+        drained afterwards — so keep-alive and pipelined framing
+        survive handlers that ignore (or partially read) bodies, and
+        chunked uploads work on every role. Bodiless requests (the
+        dominant GET path) take the stock path with zero new
+        objects."""
+        try:
+            self.raw_requestline = self.rfile.readline(_MAX_LINE + 1)
+            if len(self.raw_requestline) > _MAX_LINE:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414)
+                return
+            if not self.raw_requestline:
+                self.close_connection = True
+                return
+            if not self.parse_request():
+                return
+            body = None
+            if is_chunked(self.headers) or \
+                    parse_content_length(self.headers) > 0:
+                body = BodyReader(self.rfile, self.headers)
+            mname = "do_" + self.command
+            if not hasattr(self, mname):
+                self.send_error(
+                    501, "Unsupported method (%r)" % self.command)
+                return
+            if body is None:
+                getattr(self, mname)()
+            else:
+                raw = self.rfile
+                self.rfile = body
+                try:
+                    getattr(self, mname)()
+                finally:
+                    self.rfile = raw
+                    if not self.close_connection:
+                        try:
+                            body.drain()
+                        except (OSError, ValueError):
+                            self.close_connection = True
+            self.wfile.flush()
+        except TimeoutError as e:
+            self.log_error("Request timed out: %r", e)
+            self.close_connection = True
 
     def date_time_string(self, timestamp=None):
         if timestamp is not None:

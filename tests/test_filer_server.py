@@ -339,3 +339,39 @@ def test_prefix_subscriber_does_not_spin_on_unrelated_events(cluster):
         assert not got
     finally:
         MetaLog.read_events_since = real
+
+
+def test_filer_lookup_volume_batches_to_master(tmp_path, monkeypatch):
+    """ISSUE 13 satellite (ROADMAP item 4 residual): the filer's
+    LookupVolume gRPC fans its whole vid list through ONE
+    operations.lookup_many call instead of a master round trip per
+    vid; junk vids and per-vid failures answer as empty location
+    lists exactly like before."""
+    from seaweedfs_tpu.server import filer as filer_srv
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    calls = []
+
+    def fake_lookup_many(master_url, vids, collection=""):
+        calls.append(list(vids))
+        return {v: [f"vs{v}:8080"] if v != 9 else [] for v in vids}
+
+    monkeypatch.setattr(filer_srv.operations, "lookup_many",
+                        fake_lookup_many)
+    fs = FilerServer(master_url="127.0.0.1:1", port=18997,
+                     meta_dir=str(tmp_path))
+    try:
+        req = filer_pb2.LookupVolumeRequest(
+            volume_ids=["3", "7", "junk", "9", "3"])
+        resp = fs.LookupVolume(req, None)
+        assert calls == [[3, 7, 9]], \
+            "all vids must ride ONE batched lookup (deduped, junk " \
+            "filtered)"
+        assert [l.url for l in resp.locations_map["3"].locations] == \
+            ["vs3:8080"]
+        assert [l.url for l in resp.locations_map["7"].locations] == \
+            ["vs7:8080"]
+        assert not resp.locations_map["junk"].locations
+        assert not resp.locations_map["9"].locations
+    finally:
+        fs.filer.close()

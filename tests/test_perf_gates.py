@@ -972,3 +972,77 @@ def test_meta_disabled_overhead(tmp_path):
     # the meta plane; nothing ELSE may have appeared
     grown = after - before - {"log-buffer-flush"}
     assert len(grown) == 0, f"disabled meta plane spawned {grown}"
+
+
+def test_serve_async_disabled_overhead(tmp_path):
+    """The async serving core (ISSUE 13) must be STRICTLY zero-cost
+    while -serve.async is off — the house contract.
+
+    Gates. Construction: make_http_server without the flag builds the
+    stock TrackingHTTPServer — no AsyncHTTPServer, no selector, no
+    state-machine objects, no worker pool (proved by poisoning the
+    constructor when the module is already imported, and by the module
+    staying unimported when it is not). Hot path: the handler-side
+    seam is ONE class-attribute read (FastHandler.async_conn is None)
+    and bodiless requests build no BodyReader. Threads: a threaded
+    server answering requests grows exactly the connection threads the
+    stock model always grew — nothing async-named."""
+    import sys
+    import threading
+    import urllib.request
+
+    import seaweedfs_tpu.util.http_server as hs
+
+    mod = sys.modules.get("seaweedfs_tpu.util.async_server")
+    poisoned = []
+    if mod is not None:
+        # another test imported the async core: any construction with
+        # the flag off would trip this
+        orig_init = mod.AsyncHTTPServer.__init__
+
+        def boom(*a, **kw):
+            poisoned.append(a)
+            raise AssertionError(
+                "AsyncHTTPServer constructed with -serve.async off")
+        mod.AsyncHTTPServer.__init__ = boom
+    try:
+        class H(hs.FastHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                # the one disabled-path check handlers may pay
+                assert self.async_conn is None
+                assert not isinstance(self.rfile, hs.BodyReader), \
+                    "bodiless GET must not build a BodyReader"
+                self.fast_reply(200, b"ok")
+
+        for serve in (None, hs.ServeConfig()):
+            srv = hs.make_http_server(("127.0.0.1", 0), H,
+                                      role="gate", serve=serve)
+            assert type(srv) is hs.TrackingHTTPServer
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            try:
+                with urllib.request.urlopen(
+                        "http://127.0.0.1:%d/x"
+                        % srv.server_address[1]) as r:
+                    assert r.read() == b"ok"
+            finally:
+                srv.shutdown()
+                srv.server_close()
+        assert not poisoned
+        if mod is None:
+            assert "seaweedfs_tpu.util.async_server" not in \
+                sys.modules, \
+                "flag-off construction imported the async core"
+        # the handler seam is a class attribute, not per-instance
+        # state: no default instance carries async machinery
+        assert "async_conn" not in hs.FastHandler.__dict__ or \
+            hs.FastHandler.async_conn is None
+        assert not any("serve-" in t.name or "async" in t.name.lower()
+                       for t in threading.enumerate()), \
+            "disabled serving core left async-named threads"
+    finally:
+        if mod is not None:
+            mod.AsyncHTTPServer.__init__ = orig_init

@@ -1,0 +1,654 @@
+"""Async serving core (ISSUE 13): the selector event loop vs the
+threaded model.
+
+The headline contract is BYTE IDENTITY: every fixture in the HTTP/1.1
+parser conformance corpus — split-across-recv headers, pipelined
+keep-alive, chunked bodies, oversized header -> 431, over-long request
+line -> 414, bad versions, Expect: 100-continue — runs against BOTH
+server models and must produce the same bytes on the wire. On top of
+that: a real volume-server E2E sweep (PUT/GET/Range/304/404/504
+through both cores, sendfile exercised on the async side), the
+cross-cutting seams (metrics, deadline re-anchoring, failpoints)
+firing identically, backpressure/keep-alive-budget behavior, and the
+PR 10 schedule explorer driving the loop<->worker completion handoff
+through seeded interleavings.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import seaweedfs_tpu.util.http_server as hs
+from seaweedfs_tpu.util.async_server import (AsyncHTTPServer,
+                                             _ChunkedScanner,
+                                             _Connection)
+from seaweedfs_tpu.util.http_server import (BodyReader, FastHandler,
+                                            FileSpan, ServeConfig,
+                                            TrackingHTTPServer)
+
+FROZEN_DATE = "Thu, 01 Jan 1970 00:00:00 GMT"
+
+
+@pytest.fixture
+def frozen_date(monkeypatch):
+    """Both models must emit identical Date headers for byte compares."""
+    monkeypatch.setattr(hs, "http_date", lambda: FROZEN_DATE)
+
+
+class EchoHandler(FastHandler):
+    """Deterministic test handler exercising both reply styles."""
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if self.path == "/boom":
+            raise RuntimeError("handler crash")
+        self.fast_reply(200, b"hello:" + self.path.encode(),
+                        ctype="text/plain")
+
+    do_HEAD = do_GET
+
+    def do_POST(self):
+        body = self.read_body()
+        self.fast_reply(200, b"echo:" + body)
+
+    def do_PUT(self):
+        # stock reply style (send_response/send_header/end_headers)
+        body = self.read_body()
+        self.send_response(201)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _start(model: str, handler=EchoHandler, **kw):
+    if model == "threaded":
+        srv = TrackingHTTPServer(("127.0.0.1", 0), handler)
+    else:
+        srv = AsyncHTTPServer(("127.0.0.1", 0), handler, role="test",
+                              **kw)
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name=f"test-{model}")
+    t.start()
+    return srv
+
+
+def _stop(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def _exchange(port, payload, timeout=8.0, chunk=0, gap=0.0):
+    """Send payload (optionally dribbled in `chunk`-byte pieces) and
+    read until the server closes; returns the full byte stream."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        if chunk:
+            for i in range(0, len(payload), chunk):
+                s.sendall(payload[i:i + chunk])
+                if gap:
+                    time.sleep(gap)
+        else:
+            s.sendall(payload)
+        s.settimeout(timeout)
+        out = b""
+        while True:
+            try:
+                d = s.recv(65536)
+            except socket.timeout:
+                break
+            if not d:
+                break
+            out += d
+        return out
+    finally:
+        s.close()
+
+
+# every request asks for close at the end so _exchange terminates on
+# EOF and the byte streams compare exactly
+CORPUS = {
+    "simple": b"GET /a HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n",
+    "keepalive_pipelined": (
+        b"GET /1 HTTP/1.1\r\nHost: x\r\n\r\n"
+        b"GET /2 HTTP/1.1\r\nHost: x\r\n\r\n"
+        b"GET /3 HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"),
+    "post_content_length": (
+        b"POST /p HTTP/1.1\r\nContent-Length: 5\r\n"
+        b"Connection: close\r\n\r\nhello"),
+    "post_chunked": (
+        b"POST /p HTTP/1.1\r\nTransfer-Encoding: chunked\r\n"
+        b"Connection: close\r\n\r\n"
+        b"3\r\nabc\r\n8\r\ndefghijk\r\n0\r\n\r\n"),
+    "chunked_then_keepalive": (
+        b"POST /p HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+        b"4\r\nwxyz\r\n0\r\n\r\n"
+        b"GET /after HTTP/1.1\r\nConnection: close\r\n\r\n"),
+    "unread_body_then_next": (
+        # GET carrying a body the handler ignores: framing must
+        # survive into the pipelined follower on both models
+        b"GET /ig HTTP/1.1\r\nContent-Length: 6\r\n\r\nBODYBY"
+        b"GET /next HTTP/1.1\r\nConnection: close\r\n\r\n"),
+    "put_stock_reply": (
+        b"PUT /s HTTP/1.1\r\nContent-Length: 3\r\n"
+        b"Connection: close\r\n\r\nabc"),
+    "head": b"HEAD /h HTTP/1.1\r\nConnection: close\r\n\r\n",
+    "expect_100": (
+        b"POST /p HTTP/1.1\r\nContent-Length: 3\r\n"
+        b"Expect: 100-continue\r\nConnection: close\r\n\r\nabc"),
+    "http10": b"GET /old HTTP/1.0\r\n\r\n",
+    "bad_version": b"GET / HTTP/9.9\r\n\r\n",
+    "bad_syntax": b"GET\r\n\r\n",
+    "unknown_method": (
+        b"BREW /pot HTTP/1.1\r\nConnection: close\r\n\r\n"),
+    "oversized_header_431": (
+        b"GET / HTTP/1.1\r\nX-Big: " + b"a" * 70000 + b"\r\n\r\n"),
+    "too_many_headers_431": (
+        b"GET / HTTP/1.1\r\n" +
+        b"".join(b"X-%d: v\r\n" % i for i in range(150)) + b"\r\n"),
+    "request_line_414": b"GET /" + b"a" * 70000 + b" HTTP/1.1\r\n\r\n",
+    "zero_length_post": (
+        b"POST /p HTTP/1.1\r\nContent-Length: 0\r\n"
+        b"Connection: close\r\n\r\n"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CORPUS))
+def test_corpus_byte_identity(frozen_date, name):
+    payload = CORPUS[name]
+    outs = {}
+    for model in ("threaded", "async"):
+        srv = _start(model)
+        try:
+            outs[model] = _exchange(srv.server_address[1], payload)
+        finally:
+            _stop(srv)
+    assert outs["threaded"] == outs["async"], name
+    # the corpus must actually answer (bad_syntax closes silently;
+    # bad_version answers HTTP/0.9-style — body only — because the
+    # stock parser rejects before adopting the request version)
+    if name == "bad_version":
+        assert b"Error response" in outs["async"]
+    elif name != "bad_syntax":
+        assert outs["async"].startswith(b"HTTP/1.1 "), name
+
+
+def test_split_across_recv_headers(frozen_date):
+    """Partial-head state machine: bytes dribbled 7 at a time parse
+    identically to one send on both models."""
+    payload = CORPUS["keepalive_pipelined"]
+    outs = {}
+    for model in ("threaded", "async"):
+        srv = _start(model)
+        try:
+            outs[model] = _exchange(srv.server_address[1], payload,
+                                    chunk=7, gap=0.002)
+        finally:
+            _stop(srv)
+    assert outs["threaded"] == outs["async"]
+    assert outs["async"].count(b"HTTP/1.1 200") == 3
+
+
+def test_handler_crash_closes_after_flush(frozen_date):
+    """A crashing handler mirrors the threaded model: whatever was
+    buffered flushes, then the connection closes — and the server
+    keeps serving new connections."""
+    for model in ("threaded", "async"):
+        srv = _start(model)
+        try:
+            out = _exchange(srv.server_address[1],
+                            b"GET /boom HTTP/1.1\r\n\r\n")
+            assert out == b""  # crash before any reply bytes
+            ok = _exchange(srv.server_address[1],
+                           b"GET /ok HTTP/1.1\r\nConnection: close"
+                           b"\r\n\r\n")
+            assert b"hello:/ok" in ok
+        finally:
+            _stop(srv)
+
+
+def test_expect_100_waiting_client(frozen_date):
+    """A COMPLIANT Expect: 100-continue client waits for the interim
+    reply before transmitting the body — the async core must flush
+    the 100 before sitting in its body state (review finding: the
+    interim bytes used to queue unflushed, deadlocking both sides)."""
+    for model in ("threaded", "async"):
+        srv = _start(model)
+        try:
+            s = socket.create_connection(
+                ("127.0.0.1", srv.server_address[1]), timeout=5)
+            s.sendall(b"POST /p HTTP/1.1\r\nContent-Length: 3\r\n"
+                      b"Expect: 100-continue\r\n"
+                      b"Connection: close\r\n\r\n")
+            s.settimeout(3)
+            interim = s.recv(65536)
+            assert interim == b"HTTP/1.1 100 Continue\r\n\r\n", \
+                (model, interim)
+            s.sendall(b"abc")
+            out = b""
+            while True:
+                try:
+                    d = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not d:
+                    break
+                out += d
+            s.close()
+            assert b"echo:abc" in out, (model, out)
+        finally:
+            _stop(srv)
+
+
+def test_partial_head_fin_is_reclaimed():
+    """connect / send a partial request line / FIN must not leak the
+    connection (review finding: it dodged both the idle budget and
+    the close paths, wedging accept at max_conns)."""
+    srv = _start("async", max_conns=3)
+    try:
+        port = srv.server_address[1]
+        for _ in range(8):   # well past max_conns if leaked
+            s = socket.create_connection(("127.0.0.1", port),
+                                         timeout=5)
+            s.sendall(b"GET /partial")   # no newline, ever
+            s.close()
+            time.sleep(0.02)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and srv._conns:
+            time.sleep(0.05)
+        assert not srv._conns, "partial-head FIN connections leaked"
+        out = _exchange(port, b"GET /ok HTTP/1.1\r\nConnection: close"
+                        b"\r\n\r\n")
+        assert b"hello:/ok" in out, "server stopped accepting"
+    finally:
+        _stop(srv)
+
+
+def test_early_client_close_mid_body():
+    """A client that dies mid-body must not wedge the loop."""
+    srv = _start("async")
+    try:
+        s = socket.create_connection(
+            ("127.0.0.1", srv.server_address[1]), timeout=5)
+        s.sendall(b"POST /p HTTP/1.1\r\nContent-Length: 100000\r\n\r\n"
+                  b"only-a-little")
+        s.close()
+        # the loop must still serve others
+        out = _exchange(srv.server_address[1],
+                        b"GET /alive HTTP/1.1\r\nConnection: close"
+                        b"\r\n\r\n")
+        assert b"hello:/alive" in out
+    finally:
+        _stop(srv)
+
+
+def test_keepalive_budget_closes_lru_idle():
+    srv = _start("async", keepalive_budget=2)
+    try:
+        conns = []
+        for i in range(2):
+            s = socket.create_connection(
+                ("127.0.0.1", srv.server_address[1]), timeout=5)
+            s.sendall(b"GET /%d HTTP/1.1\r\n\r\n" % i)
+            conns.append(s)
+        time.sleep(0.3)
+        # the third idle keep-alive connection pushes the oldest out
+        s3 = socket.create_connection(
+            ("127.0.0.1", srv.server_address[1]), timeout=5)
+        s3.sendall(b"GET /2 HTTP/1.1\r\n\r\n")
+        conns.append(s3)
+        deadline = time.monotonic() + 5
+        closed = 0
+        while time.monotonic() < deadline and closed == 0:
+            for s in conns[:1]:   # the LRU one
+                s.settimeout(0.2)
+                try:
+                    if s.recv(65536) == b"":
+                        closed += 1
+                except socket.timeout:
+                    pass
+                except OSError:
+                    closed += 1
+        assert closed == 1, "LRU idle connection was not shed"
+        for s in conns:
+            s.close()
+    finally:
+        _stop(srv)
+
+
+def test_accept_backpressure_recovers():
+    """Past max_conns the listener pauses; closing a connection
+    resumes accepting and queued clients get served."""
+    srv = _start("async", max_conns=2)
+    try:
+        port = srv.server_address[1]
+        s1 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s1.sendall(b"GET /1 HTTP/1.1\r\n\r\n")
+        s2.sendall(b"GET /2 HTTP/1.1\r\n\r\n")
+        time.sleep(0.3)
+        # third connection sits in the backlog until one closes
+        s1.close()
+        out = _exchange(port, b"GET /3 HTTP/1.1\r\nConnection: close"
+                        b"\r\n\r\n")
+        assert b"hello:/3" in out
+        s2.close()
+    finally:
+        _stop(srv)
+
+
+# -- BodyReader / scanner units ----------------------------------------------
+
+
+def test_body_reader_chunked_decode_and_drain():
+    raw = io.BufferedReader(io.BytesIO(
+        b"3\r\nabc\r\n2\r\nde\r\n0\r\nX-Trailer: v\r\n\r\nLEFTOVER"))
+    r = BodyReader(raw, {"transfer-encoding": "chunked"})
+    assert r.read(4) == b"abcd"
+    r.drain()
+    assert r.read() == b""
+    assert raw.read() == b"LEFTOVER"   # trailers consumed exactly
+
+
+def test_body_reader_content_length_cap():
+    raw = io.BufferedReader(io.BytesIO(b"12345NEXTREQ"))
+    r = BodyReader(raw, {"content-length": "5"})
+    assert r.read(99) == b"12345"
+    assert r.read(1) == b""
+    assert raw.read() == b"NEXTREQ"
+
+
+def test_body_reader_bad_chunk_raises():
+    raw = io.BufferedReader(io.BytesIO(b"zz\r\nabc\r\n0\r\n\r\n"))
+    r = BodyReader(raw, {"transfer-encoding": "chunked"})
+    with pytest.raises(ValueError):
+        r.read()
+
+
+def test_chunked_scanner_incremental():
+    msg = b"3\r\nabc\r\n8\r\ndefghijk\r\n0\r\nT: v\r\n\r\nTAIL"
+    for step in (1, 2, 3, 7, len(msg)):
+        sc = _ChunkedScanner()
+        buf = bytearray()
+        pos, done = 0, False
+        i = 0
+        while i < len(msg) and not done:
+            buf += msg[i:i + step]
+            i += step
+            pos, done = sc.feed(buf, pos)
+        assert done and not sc.error
+        # the terminator lands exactly after the trailer blank line;
+        # bytes past it (the pipelined follower) stay unconsumed
+        assert bytes(buf)[:pos].endswith(b"\r\n\r\n")
+        assert msg[pos:] == b"TAIL"
+
+
+# -- volume server E2E: both cores, byte-identical sweep ----------------------
+
+
+@pytest.fixture(scope="module")
+def paired_clusters(tmp_path_factory):
+    """Two single-volume-server clusters, one per serving model."""
+    from cluster_util import Cluster
+    clusters = {}
+    for model in ("threaded", "async"):
+        kw = {}
+        if model == "async":
+            kw["serve"] = ServeConfig(async_mode=True)
+        clusters[model] = Cluster(
+            tmp_path_factory.mktemp(f"serve-{model}"),
+            n_volume_servers=1, volume_kwargs=kw)
+    yield clusters
+    for cl in clusters.values():
+        cl.stop()
+    # leave the process as quiet as we found it: pooled keep-alive
+    # sockets to the dead clusters and the churn of two clusters'
+    # worth of garbage must not nudge timing-gated suites that run
+    # later in the same process
+    import gc
+
+    from seaweedfs_tpu.util import http_client
+    http_client.close_all()
+    gc.collect()
+
+
+def _upload(cl, data: bytes, name="t.bin"):
+    with urllib.request.urlopen(
+            f"http://{cl.master.url}/dir/assign") as r:
+        a = json.load(r)
+    boundary = "b0undary"
+    body = ((f"--{boundary}\r\nContent-Disposition: form-data; "
+             f'name="file"; filename="{name}"\r\n'
+             "Content-Type: application/octet-stream\r\n\r\n")
+            .encode() + data +
+            f"\r\n--{boundary}--\r\n".encode())
+    req = urllib.request.Request(
+        f"http://{a['url']}/{a['fid']}", data=body, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req) as r:
+        post = r.read()
+    return a["url"], a["fid"], post
+
+
+def _raw(url, fid, extra="", verb="GET"):
+    host, port = url.split(":")
+    payload = (f"{verb} /{fid} HTTP/1.1\r\nHost: {url}\r\n{extra}"
+               "Connection: close\r\n\r\n").encode()
+    return _exchange(int(port), payload)
+
+
+def test_volume_e2e_byte_identity(frozen_date, paired_clusters):
+    """The acceptance sweep: identical content written through both
+    cores answers byte-identically for every read shape (the async
+    side serving through sendfile), and the POST acks match too."""
+    data = os.urandom(200000) + b"MARKER" + b"z" * 500
+    etag = None
+    sweeps = {}
+    for model, cl in paired_clusters.items():
+        url, fid, post = _upload(cl, data)
+        if etag is None:
+            etag = json.loads(post)["eTag"]
+        sweep = {
+            "post_ack": post,
+            "get": _raw(url, fid),
+            "head": _raw(url, fid, verb="HEAD"),
+            "range": _raw(url, fid, "Range: bytes=200000-200005\r\n"),
+            "range_tail": _raw(url, fid, "Range: bytes=-6\r\n"),
+            "range_416": _raw(url, fid,
+                              "Range: bytes=999999999-\r\n"),
+            "inm_304": _raw(url, fid,
+                            f'If-None-Match: "{etag}"\r\n'),
+            "cookie_404": _raw(url, fid[:-4] + "beef"),
+            "deadline_504": _raw(url, fid,
+                                 "X-Seaweed-Deadline: 0.000\r\n"),
+        }
+        # the 504 body names the volume id, which differs between the
+        # two independent clusters — normalize it before comparing
+        vid = fid.split(",")[0].encode()
+        sweep["deadline_504"] = sweep["deadline_504"].replace(
+            b"volume " + vid + b" read", b"volume N read")
+        sweeps[model] = sweep
+    for key in sweeps["threaded"]:
+        assert sweeps["threaded"][key] == sweeps["async"][key], key
+    assert sweeps["async"]["get"].endswith(data)
+    assert b"206 Partial Content" in sweeps["async"]["range"]
+    assert b"MARKER" in sweeps["async"]["range"]
+    assert b"304" in sweeps["async"]["inm_304"]
+    assert b"504" in sweeps["async"]["deadline_504"]
+    # the async sweep actually went zero-copy
+    from seaweedfs_tpu.stats.metrics import ServeSendfileBytesCounter
+    assert ServeSendfileBytesCounter.labels("volume").value >= \
+        len(data)
+
+
+def test_volume_seams_fire_identically(frozen_date, paired_clusters):
+    """Metrics, failpoints, and trace spans behave the same under
+    both cores (the cross-cutting seams the tentpole must not
+    disturb)."""
+    from seaweedfs_tpu.resilience import failpoint
+    from seaweedfs_tpu.stats.metrics import RequestCounter
+    data = b"seam-check" * 100
+    per_model = {}
+    for model, cl in paired_clusters.items():
+        url, fid, _ = _upload(cl, data)
+        counter = RequestCounter.labels("volumeServer", "get")
+        before = counter.value
+        ok = _raw(url, fid)
+        failpoint.arm("volume.read", "error")
+        try:
+            failed = _raw(url, fid)
+        finally:
+            failpoint.disarm()
+        after_fp = _raw(url, fid)
+        per_model[model] = (ok.partition(b"\r\n\r\n")[2],
+                            failed.split(b"\r\n", 1)[0],
+                            after_fp.partition(b"\r\n\r\n")[2],
+                            counter.value - before)
+    assert per_model["threaded"] == per_model["async"]
+    body, failline, recovered, delta = per_model["async"]
+    assert body == data and recovered == data
+    assert failline == b"HTTP/1.1 500 Internal Server Error"
+    assert delta == 3.0   # every request metered on both cores
+
+
+def test_sendfile_off_still_identical(frozen_date, tmp_path):
+    """-serve.sendfile=false: async serves through the byte path,
+    responses unchanged."""
+    from cluster_util import Cluster
+    cl = Cluster(tmp_path, n_volume_servers=1,
+                 volume_kwargs={"serve": ServeConfig(
+                     async_mode=True, sendfile=False)})
+    try:
+        data = b"no-sendfile" * 1000
+        url, fid, _ = _upload(cl, data)
+        out = _raw(url, fid)
+        assert out.endswith(data)
+    finally:
+        cl.stop()
+
+
+# -- schedule-explorer proof of the completion handoff ------------------------
+
+
+class _NullHandler(FastHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+
+def _fresh_server():
+    return AsyncHTTPServer(("127.0.0.1", 0), _NullHandler,
+                           role="explorer")
+
+
+def test_explorer_completion_vs_close():
+    """The one cross-thread seam: a worker publishing a finished
+    response races the loop closing the connection (peer reset). Under
+    seeded interleavings the span fd must be released exactly once and
+    nothing raises — completions for a dead connection drop, live ones
+    reach the out queue."""
+    from seaweedfs_tpu.util import scheduler
+
+    def body():
+        srv = _fresh_server()
+        a, b = socket.socketpair()
+        try:
+            a.setblocking(False)
+            conn = _Connection(a, ("127.0.0.1", 9))
+            srv._conns[conn.fd] = conn
+            r, w = os.pipe()
+            os.close(w)
+            span = FileSpan(r, 0, 4)
+            errors = []
+
+            def worker():
+                try:
+                    srv._complete(conn, [b"HTTP/1.1 200 OK\r\n\r\n",
+                                         span], close=False)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            def loop():
+                try:
+                    srv._close_conn(conn)
+                    srv._handle_completions()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+            t1 = threading.Thread(target=worker)
+            t2 = threading.Thread(target=loop)
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+            # drain any handoff the close lost the race to
+            srv._handle_completions()
+            conn.drop_buffers()
+            assert not errors, errors
+            assert span.fd == -1, "span fd leaked through the race"
+            assert conn.pending is None
+        finally:
+            b.close()
+            srv.server_close()
+
+    scheduler.explore(body, schedules=20, seed=0)
+
+
+def test_explorer_pipelined_completion_order():
+    """Loop-side sanity under interleavings: two conns completing on
+    worker threads both reach their own out queues; nothing crosses
+    connections."""
+    from seaweedfs_tpu.util import scheduler
+
+    def body():
+        srv = _fresh_server()
+        socks = []
+        try:
+            conns, peers = [], []
+            for i in range(2):
+                a, b = socket.socketpair()
+                socks += [a, b]
+                a.setblocking(False)
+                b.setblocking(False)
+                conn = _Connection(a, ("127.0.0.1", i))
+                srv._conns[conn.fd] = conn
+                conns.append(conn)
+                peers.append(b)
+
+            def worker(i):
+                srv._complete(conns[i], [b"RESP%d" % i], close=False)
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            srv._handle_completions()
+            for t in ts:
+                t.join()
+            srv._handle_completions()
+            for i, (conn, peer) in enumerate(zip(conns, peers)):
+                # the response either drained to the peer already or
+                # still sits queued on its OWN connection — never
+                # lost, never crossed
+                queued = b"".join(bytes(c) for c in conn.out)
+                try:
+                    arrived = peer.recv(64)
+                except BlockingIOError:
+                    arrived = b""
+                assert arrived + queued == b"RESP%d" % i, \
+                    (i, arrived, queued)
+        finally:
+            for s in socks:
+                s.close()
+            srv.server_close()
+
+    scheduler.explore(body, schedules=20, seed=0)
