@@ -93,6 +93,7 @@ def _master_parser() -> argparse.ArgumentParser:
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_trace_args(p)
+    _add_qos_args(p)
     return p
 
 
@@ -212,6 +213,7 @@ def run_master(args) -> int:
     _setup_tls("master")
     opts = _master_parser().parse_args(args)
     _configure_trace(opts)
+    _configure_qos(opts)
     grace.setup_profiling(opts.cpuprofile)
     _maybe_start_metrics(opts, role="master")
     m = _build_master(opts)
@@ -309,6 +311,7 @@ def _volume_parser() -> argparse.ArgumentParser:
     _add_resilience_args(p)
     _add_trace_args(p)
     _add_serve_args(p)
+    _add_qos_args(p)
     return p
 
 
@@ -424,6 +427,131 @@ def _configure_resilience(opts) -> None:
             cooldown_s=opts.resilience_breaker_cooldown)
 
 
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def _add_qos_args(p: argparse.ArgumentParser) -> None:
+    """Shared -qos.* flags (master/volume/filer/s3/server; see
+    seaweedfs_tpu/qos/). Everything defaults OFF — with QoS disabled no
+    bucket exists, no tenant is resolved, and every seam costs one
+    identity check (tests/test_perf_gates.py::test_qos_disabled_overhead).
+    SEAWEED_QOS* environment variables supply fleet-wide defaults the
+    flags override per process."""
+    p.add_argument("-qos", dest="qos", action="store_true",
+                   default=_env_flag("SEAWEED_QOS"),
+                   help="enable multi-tenant QoS: per-tenant admission "
+                        "buckets, weighted-fair pool scheduling, and "
+                        "explicit 429/503+Retry-After backpressure "
+                        "(env default SEAWEED_QOS)")
+    p.add_argument("-qos.requestRate", dest="qos_request_rate",
+                   type=float,
+                   default=_env_float("SEAWEED_QOS_REQUEST_RATE", 0.0),
+                   help="per-tenant admitted requests/second (0 = "
+                        "unlimited; env default SEAWEED_QOS_REQUEST_RATE)")
+    p.add_argument("-qos.requestBurst", dest="qos_request_burst",
+                   type=float, default=0.0,
+                   help="per-tenant request burst cap (0 = 2x rate)")
+    p.add_argument("-qos.bytesMBps", dest="qos_bytes_mbps", type=float,
+                   default=_env_float("SEAWEED_QOS_BYTES_MBPS", 0.0),
+                   help="per-tenant admitted ingress MB/s judged from "
+                        "Content-Length (0 = unlimited; env default "
+                        "SEAWEED_QOS_BYTES_MBPS)")
+    p.add_argument("-qos.bytesBurstS", dest="qos_bytes_burst_s",
+                   type=float, default=2.0,
+                   help="seconds of byte budget a tenant may bank")
+    p.add_argument("-qos.globalRequestRate", dest="qos_global_rate",
+                   type=float, default=0.0,
+                   help="whole-process admitted requests/second across "
+                        "all tenants; when heat shedding is armed a "
+                        "quarter of it is reserved for hot-volume "
+                        "traffic so cold reads shed first (0 = "
+                        "unlimited)")
+    p.add_argument("-qos.weights", dest="qos_weights",
+                   default=os.environ.get("SEAWEED_QOS_WEIGHTS", ""),
+                   help="per-tenant fair-share weights as "
+                        "name:weight,name:weight (env default "
+                        "SEAWEED_QOS_WEIGHTS)")
+    p.add_argument("-qos.defaultWeight", dest="qos_default_weight",
+                   type=float, default=1.0,
+                   help="fair-share weight for tenants not in "
+                        "-qos.weights")
+    p.add_argument("-qos.internalWeight", dest="qos_internal_weight",
+                   type=float, default=0.25,
+                   help="fair-share weight of the _internal tenant "
+                        "(scrub/lifecycle/filer_sync background work)")
+    p.add_argument("-qos.maxTenants", dest="qos_max_tenants", type=int,
+                   default=64,
+                   help="distinct tenants tracked before the overflow "
+                        "tenant _other absorbs the rest (bounds bucket "
+                        "memory and metric label cardinality)")
+    p.add_argument("-qos.heatShed", dest="qos_heat_shed",
+                   type=lambda s: s.lower() not in ("0", "false", "no"),
+                   default=True,
+                   help="under global overload, prefer shedding reads "
+                        "of cold volumes (needs -heat.track on the "
+                        "volume server; false = shed uniformly)")
+
+
+def _parse_qos_weights(spec: str) -> dict:
+    weights = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weights[name.strip()] = float(w)
+        except ValueError:
+            raise SystemExit(
+                f"-qos.weights: expected name:weight, got {part!r}")
+    return weights
+
+
+def _configure_qos(opts) -> None:
+    """Build and install the process-wide QosManager from the -qos.*
+    flags. Without -qos nothing is imported and every seam stays None
+    (the combined `server` role shares the one manager across all its
+    roles — there is exactly one per process by design)."""
+    if not getattr(opts, "qos", False):
+        return
+    from seaweedfs_tpu import qos
+    from seaweedfs_tpu.qos.admission import QosConfig
+    qos.configure(QosConfig(
+        request_rate=opts.qos_request_rate,
+        request_burst=opts.qos_request_burst,
+        bytes_mbps=opts.qos_bytes_mbps,
+        bytes_burst_s=opts.qos_bytes_burst_s,
+        global_request_rate=opts.qos_global_rate,
+        weights=_parse_qos_weights(opts.qos_weights),
+        default_weight=opts.qos_default_weight,
+        internal_weight=opts.qos_internal_weight,
+        max_tenants=opts.qos_max_tenants,
+        heat_shed=opts.qos_heat_shed))
+    log.info("qos on (rate=%s/s bytes=%sMB/s global=%s/s)",
+             opts.qos_request_rate or "inf",
+             opts.qos_bytes_mbps or "inf",
+             opts.qos_global_rate or "inf")
+
+
+def _attach_qos_heat(vs) -> None:
+    """Hand the volume server's HeatTracker to the QoS manager so
+    -qos.heatShed can tell hot volumes from cold under global
+    overload. No-op unless BOTH -qos and -heat.track are on."""
+    from seaweedfs_tpu import qos
+    mgr = qos.manager()
+    if mgr is not None and getattr(vs, "heat", None) is not None:
+        mgr.heat = vs.heat
+
+
 def _storage_backend_conf() -> dict:
     """Flatten master.toml's [storage.backend.<scheme>.<id>] sections to
     {"scheme.id": props} (reference backend.go LoadConfiguration)."""
@@ -479,9 +607,11 @@ def run_volume(args) -> int:
     opts = _volume_parser().parse_args(args)
     _configure_resilience(opts)
     _configure_trace(opts)
+    _configure_qos(opts)
     grace.setup_profiling(opts.cpuprofile)
     _maybe_start_metrics(opts, role="volume")
     vs = _build_volume(opts)
+    _attach_qos_heat(vs)
     vs.start()
     return _serve_forever([vs])
 
@@ -609,6 +739,7 @@ def run_filer(args) -> int:
     opts = _filer_parser().parse_args(args)
     _configure_resilience(opts)
     _configure_trace(opts)
+    _configure_qos(opts)
     _configure_meta(opts)   # BEFORE the build: MasterClient arms at init
     _maybe_start_metrics(opts, role="filer")
     fs = _build_filer(opts)
@@ -646,12 +777,14 @@ def _s3_parser() -> argparse.ArgumentParser:
     p.add_argument("-metricsPort", dest="metrics_port", type=int,
                    default=0, help="Prometheus /metrics pull port")
     _add_serve_args(p)
+    _add_qos_args(p)
     return p
 
 
 @command("s3", "start an S3-compatible gateway")
 def run_s3(args) -> int:
     opts = _s3_parser().parse_args(args)
+    _configure_qos(opts)
     _maybe_start_metrics(opts, role="s3")
     from seaweedfs_tpu.s3api.server import S3ApiServer
     s3 = S3ApiServer(opts.filer, ip=opts.ip, port=opts.port,
@@ -718,7 +851,11 @@ def run_server(args) -> int:
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-volumeSizeLimitMB", dest="volume_size_limit_mb",
                    type=int, default=30 * 1000)
+    _add_qos_args(p)
     opts = p.parse_args(args)
+    # one process-wide manager shared by every role in the combined
+    # server: all of them meter against the same tenant buckets
+    _configure_qos(opts)
 
     mopts = _master_parser().parse_args(
         ["-ip", opts.ip, "-port", str(opts.master_port),
@@ -733,6 +870,7 @@ def run_server(args) -> int:
          "-max", str(opts.volume_max),
          "-mserver", f"{opts.ip}:{opts.master_port}"])
     vol = _build_volume(vopts)
+    _attach_qos_heat(vol)
     vol.start()
 
     stack = [master, vol]

@@ -165,7 +165,12 @@ class LifecycleEngine:
             if not self.master.raft.is_leader:
                 continue
             try:
-                self._run_pass()
+                # encode/offload passes run as the _internal QoS
+                # tenant: low fair-share weight on the stores' pools,
+                # exempt from admission shed (no-op context when off)
+                from seaweedfs_tpu import qos
+                with qos.internal_context():
+                    self._run_pass()
             except Exception:
                 log.exception("lifecycle pass crashed")
 

@@ -37,6 +37,13 @@ class _OneWay:
         self._thread.start()
 
     def _loop(self, since_ns: int) -> None:
+        # the replication tail runs as the _internal QoS tenant for its
+        # whole life: its re-uploads ride the destination's pools at
+        # low fair-share weight and are exempt from admission shed.
+        # Entered once, never exited — the tenant scope dies with this
+        # daemon thread's context (no-op context when QoS is off).
+        from seaweedfs_tpu import qos
+        qos.internal_context().__enter__()
         while not self._stopping:
             try:
                 self._call = filer_stub(self.src_url).SubscribeMetadata(

@@ -23,6 +23,13 @@ from seaweedfs_tpu.resilience import failpoint as _failpoint
 
 GRPC_PORT_OFFSET = 10000
 
+# QoS tenant propagation seam: seaweedfs_tpu.qos.configure() installs
+# the tenant ContextVar here (reset() clears it) so outbound stubs
+# forward the ambient tenant as x-seaweed-tenant metadata. None — the
+# default — keeps invoke() one identity check away from the plain path.
+_qos_tenant = None
+_QOS_TENANT_KEY = "x-seaweed-tenant"
+
 _channel_lock = threading.Lock()
 _channels: Dict[str, grpc.Channel] = {}
 # bumped on close_channels; invalidates the stub cache. make_stub's
@@ -130,6 +137,12 @@ def _resilient_call(multicallable, path: str):
             if hdr is not None:
                 md = list(kwargs.get("metadata") or ())
                 md.append((_ctrace.GRPC_KEY, hdr))
+                kwargs["metadata"] = md
+        if _qos_tenant is not None:
+            _t = _qos_tenant.get()
+            if _t is not None:
+                md = list(kwargs.get("metadata") or ())
+                md.append((_QOS_TENANT_KEY, _t))
                 kwargs["metadata"] = md
         return multicallable(request_or_iterator, timeout=timeout,
                              **kwargs)

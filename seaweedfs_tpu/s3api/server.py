@@ -208,6 +208,16 @@ def _error_xml(code: str, message: str, resource: str) -> bytes:
         _xml("Resource", text=resource)))
 
 
+def slow_down_xml(resource: str) -> bytes:
+    """The S3 throttle error body (HTTP 503 + Code=SlowDown): what AWS
+    returns when a prefix is over its request-rate budget, and what
+    every S3 SDK's retry layer already understands. The QoS admission
+    layer (qos/admission.py shed_reply) sends this on the s3 role so
+    shed tenants back off via their SDK instead of seeing opaque 429s."""
+    return _error_xml("SlowDown", "Please reduce your request rate.",
+                      resource)
+
+
 # -- handler ------------------------------------------------------------------
 
 

@@ -896,6 +896,26 @@ class MasterServer:
             out[str(vid)] = rec
         return {"volumes": out}
 
+    def http_cluster_qos(self) -> dict:
+        """GET /cluster/qos: this master's own QoS block plus every
+        data node's /qos/status, fanned out with short per-node
+        timeouts — what the `cluster.qos` shell command renders. A
+        node that doesn't answer (older build, mid-restart) reports an
+        error entry instead of failing the whole view."""
+        from seaweedfs_tpu import qos
+        from seaweedfs_tpu.util import http_client
+        mgr = qos.manager()
+        out = {"master": mgr.status() if mgr is not None
+               else {"enabled": False}, "nodes": {}}
+        for n in self.topo.nodes():
+            try:
+                resp = http_client.request(
+                    "GET", f"{n.url}/qos/status", timeout=2.0)
+                out["nodes"][n.url] = json.loads(resp.body)
+            except Exception as e:  # noqa: BLE001 - per-node best effort
+                out["nodes"][n.url] = {"error": str(e)}
+        return out
+
     def http_lifecycle(self, params: dict, method: str = "GET") -> dict:
         """GET/POST /cluster/lifecycle: status (default), and the
         volume.lifecycle verbs — pause / resume / force."""
@@ -995,6 +1015,15 @@ def _make_http_handler(ms: MasterServer):
                 # /status twin) — never proxied
                 self._json(ms.http_status())
                 return
+            if upath == "/qos/status":
+                # this process's own QoS admission state — never
+                # proxied (every role answers for itself; the fanned
+                # cluster view is /cluster/qos)
+                from seaweedfs_tpu import qos
+                mgr = qos.manager()
+                self._json(mgr.status() if mgr is not None
+                           else {"enabled": False})
+                return
             if upath != "/cluster/status" and self._proxy_to_leader():
                 return
             if upath == "/dir/assign":
@@ -1014,6 +1043,8 @@ def _make_http_handler(ms: MasterServer):
                 self._json(ms.http_cluster_status())
             elif upath == "/cluster/heat":
                 self._json(ms.http_cluster_heat())
+            elif upath == "/cluster/qos":
+                self._json(ms.http_cluster_qos())
             elif upath == "/cluster/lifecycle":
                 self._json(ms.http_lifecycle(params, self.command))
             elif upath in ("/", "/ui"):

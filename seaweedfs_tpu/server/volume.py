@@ -1360,6 +1360,14 @@ def _make_http_handler(vs: VolumeServer):
             if upath == "/status":
                 self._json(self.server_status())
                 return
+            if upath == "/qos/status":
+                # the data plane's own QoS admission state (the master
+                # aggregates these under /cluster/qos)
+                from seaweedfs_tpu import qos
+                mgr = qos.manager()
+                self._json(mgr.status() if mgr is not None
+                           else {"enabled": False})
+                return
             if upath in ("/debug/trace", "/debug/requests"):
                 # cluster-trace collector + flight recorder on the data
                 # port too: cluster.trace fans out over topology node

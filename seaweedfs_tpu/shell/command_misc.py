@@ -217,6 +217,53 @@ def cluster_heat(env: CommandEnv, argv: List[str], out) -> None:
             f"servers:{','.join(rec.get('servers', [])) or '-'}\n")
 
 
+@command("cluster.qos", "per-tenant admission state, cluster-wide")
+def cluster_qos(env: CommandEnv, argv: List[str], out) -> None:
+    """Render the master's fanned QoS view (GET /cluster/qos): per
+    server, per tenant — weight, admitted/shed counts by reason, live
+    bucket tokens, and open connections. Empty unless servers run
+    -qos."""
+    from seaweedfs_tpu.util import http_client
+    p = argparse.ArgumentParser(prog="cluster.qos")
+    p.add_argument("-tenant", default="",
+                   help="restrict to one tenant name")
+    args = p.parse_args(argv)
+    resp = http_client.request(
+        "GET", f"{env.master_url}/cluster/qos", timeout=30)
+    view = json.loads(resp.body)
+    blocks = [("master", view.get("master", {}))]
+    blocks += sorted(view.get("nodes", {}).items())
+    any_enabled = False
+    for url, st in blocks:
+        if st.get("error"):
+            out.write(f"{url}: unreachable ({st['error']})\n")
+            continue
+        if not st.get("enabled"):
+            continue
+        any_enabled = True
+        out.write(f"{url}: rate:{st.get('request_rate') or 'inf'}/s "
+                  f"bytes:{st.get('bytes_mbps') or 'inf'}MB/s "
+                  f"global:{st.get('global_request_rate') or 'inf'}/s "
+                  f"heatShed:{st.get('heat_shed')}\n")
+        tenants = st.get("tenants", {})
+        if args.tenant:
+            tenants = {k: v for k, v in tenants.items()
+                       if k == args.tenant}
+        for name, t in sorted(tenants.items()):
+            shed = t.get("shed", {})
+            shed_s = " ".join(f"{k}:{v}" for k, v in sorted(shed.items())
+                              if v) or "0"
+            tok = t.get("tokens", {})
+            out.write(
+                f"  {name}{' (internal)' if t.get('internal') else ''} "
+                f"w:{t.get('weight')} admitted:{t.get('admitted')} "
+                f"shed:{shed_s} conns:{t.get('conns', 0)} "
+                f"tokens(req:{tok.get('requests')} "
+                f"bytes:{tok.get('bytes')})\n")
+    if not any_enabled:
+        out.write("qos disabled everywhere (start servers with -qos)\n")
+
+
 @command("lock", "acquire the cluster admin lock")
 def lock(env: CommandEnv, argv: List[str], out) -> None:
     env.acquire_lock()

@@ -467,6 +467,31 @@ ServeShedCounter = REGISTRY.counter(
     "connections shed by the async core's backpressure",
     ("role", "kind"))
 
+# Multi-tenant QoS families (seaweedfs_tpu/qos/, -qos.*). `tenant`
+# cardinality is bounded by -qos.maxTenants: past the cap every new
+# name charges (and labels as) the shared "_other" tenant. `reason`
+# is bounded: requests | bytes | global | conns. `kind` is bounded:
+# requests | bytes.
+QosAdmittedCounter = REGISTRY.counter(
+    "SeaweedFS_qos_admitted_total",
+    "requests admitted by QoS admission control", ("tenant",))
+QosShedCounter = REGISTRY.counter(
+    "SeaweedFS_qos_shed_total",
+    "requests and connections shed by QoS admission control",
+    ("tenant", "reason"))
+QosQueuedSecondsHistogram = REGISTRY.histogram(
+    "SeaweedFS_qos_queued_seconds",
+    "time tasks waited in the weighted-fair pool queues", ("tenant",),
+    buckets=(.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+             1.0, 2.5))
+QosTokensGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_tokens",
+    "current admission bucket credit per tenant",
+    ("tenant", "kind"))
+QosTenantsGauge = REGISTRY.gauge(
+    "SeaweedFS_qos_tenants",
+    "tenants tracked by the QoS manager")
+
 # Swallowed-error ledger (the `swallow` house rule, ISSUE 8): broad
 # except handlers that deliberately absorb an error must leave a trace
 # — either a log line or this counter. `site` is a short static label
@@ -655,6 +680,18 @@ _register_process_metrics()
 # resolved once at wrap time — labels() takes a lock per call, which is
 # measurable at data-plane request rates.
 
+# QoS admission seam: seaweedfs_tpu.qos.configure() installs its
+# manager here (and tears it out on reset()). The wrappers below are
+# ALSO the QoS ingress for every enforced role — None (the default)
+# keeps both request paths one identity check away from unchanged.
+_qos_http = None
+
+# roles whose ingress enforces admission (the QoS design's contract:
+# volumeServer/filer/s3 are the tenant-facing planes (the role
+# strings the servers instrument with); master and webdav
+# control/edge traffic is observed but never shed here
+_QOS_ROLES = ("volumeServer", "filer", "s3")
+
 def instrument_http_handler(handler_cls, role: str):
     """Wrap every do_* verb method of a BaseHTTPRequestHandler subclass
     with the request counter + latency histogram (+ a trace span when
@@ -670,7 +707,9 @@ def instrument_http_handler(handler_cls, role: str):
     (pooled HTTP, gRPC, retries, fan-out pools) inherits both.
     Requests without the headers pay one dict lookup + one flag check."""
     from seaweedfs_tpu.resilience import deadline as deadline_mod
+    from seaweedfs_tpu.qos import tenant as qos_tenant
     from seaweedfs_tpu.stats import cluster_trace, trace
+    qos_enforced = role in _QOS_ROLES
 
     if not getattr(handler_cls, "_status_hooked", False):
         # record the last status code sent, so the tail sampler can
@@ -693,6 +732,16 @@ def instrument_http_handler(handler_cls, role: str):
 
         def wrapped(self):
             t0 = time.perf_counter()
+            qtok = None
+            if qos_enforced and _qos_http is not None:
+                # admission BEFORE any per-request machinery: a shed
+                # request writes its 429/503 + Retry-After and costs
+                # only the counter/histogram observation below
+                qtok = _qos_http.http_enter(self, role)
+                if qtok is None:
+                    counter.inc()
+                    histogram.observe(time.perf_counter() - t0)
+                    return
             token = None
             hdr = self.headers.get(deadline_mod.HEADER_LOWER)
             if hdr is not None:
@@ -718,6 +767,8 @@ def instrument_http_handler(handler_cls, role: str):
                 raise
             finally:
                 sp.__exit__(None, None, None)
+                if qtok is not None:
+                    qos_tenant.current.reset(qtok)
                 if token is not None:
                     deadline_mod.reset(token)
                 counter.inc()
@@ -758,7 +809,9 @@ def instrument_grpc_method(fn, role: str, method_name: str,
     x-seaweed-trace metadata key re-anchors the cluster-trace context
     (streams are exempt — they live for the process lifetime)."""
     from seaweedfs_tpu.resilience import deadline as deadline_mod
+    from seaweedfs_tpu.qos import tenant as qos_tenant
     from seaweedfs_tpu.stats import cluster_trace, trace
+    qos_enforced = role in _QOS_ROLES
     counter = RequestCounter.labels(role, method_name)
     histogram = RequestHistogram.labels(role, method_name)
     span_name = f"grpc.{role}.{method_name}"
@@ -769,6 +822,11 @@ def instrument_grpc_method(fn, role: str, method_name: str,
             yield from fn(request, context)
     else:
         def wrapped(request, context):
+            qtok = None
+            if qos_enforced and _qos_http is not None:
+                # shed aborts the call with RESOURCE_EXHAUSTED (abort
+                # raises, so nothing below runs for a shed request)
+                qtok = _qos_http.grpc_enter(context)
             t0 = time.perf_counter()
             token = None
             rem = context.time_remaining()
@@ -800,6 +858,8 @@ def instrument_grpc_method(fn, role: str, method_name: str,
                 raise
             finally:
                 sp.__exit__(None, None, None)
+                if qtok is not None:
+                    qos_tenant.current.reset(qtok)
                 if token is not None:
                     deadline_mod.reset(token)
                 counter.inc()

@@ -69,6 +69,16 @@ log = wlog.logger("async_server")
 DEFAULT_MAX_CONNS = 4096
 DEFAULT_KEEPALIVE_BUDGET = 1024
 DEFAULT_WORKERS = 16
+# QoS seam: seaweedfs_tpu.qos.configure() installs its manager here
+# (reset() clears it). With it armed, -serve.maxConns / keep-alive
+# budgets become WEIGHTED per-tenant budgets: an over-share tenant is
+# refused at frame time — before a worker thread is burned — and its
+# idle keep-alives are the first reclaimed. None (default) keeps every
+# loop path one identity check away from unchanged.
+_qos = None
+# fraction of max_conns past which frame-time conn policing kicks in
+# (below it there is no contention worth refusing anyone over)
+_QOS_CONN_HIGH_WATER = 0.875
 # most bytes buffered ahead of the current request before the loop
 # stops reading a connection (aggressive pipeliners can't balloon RAM)
 _PIPELINE_CAP = 262144
@@ -177,7 +187,8 @@ class _Connection:
     __slots__ = ("sock", "fd", "addr", "inbuf", "body", "body_scan",
                  "body_remaining", "chunker", "shim", "out", "state",
                  "close_after", "eof", "read_on", "write_on",
-                 "pending", "dead", "last_active", "expect_sent")
+                 "pending", "dead", "last_active", "expect_sent",
+                 "tenant")
 
     def __init__(self, sock, addr):
         self.sock = sock
@@ -199,6 +210,7 @@ class _Connection:
         self.dead = False                                 # guarded_by(server._lock)
         self.last_active = 0.0
         self.expect_sent = False
+        self.tenant = None   # QoS identity (set at first framed request)
 
     def drop_buffers(self) -> None:
         """Release FileSpans queued on a connection that will never
@@ -261,6 +273,7 @@ class AsyncHTTPServer:
         self._shed_accept = ServeShedCounter.labels(self.role, "accept")
         self._shed_idle = ServeShedCounter.labels(self.role,
                                                   "keepalive")
+        self._shed_qos = ServeShedCounter.labels(self.role, "qos")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -368,6 +381,11 @@ class AsyncHTTPServer:
         if self._conns.get(conn.fd) is not conn:
             return   # already closed (fd possibly reused — leave it)
         del self._conns[conn.fd]
+        if conn.tenant is not None:
+            mgr = _qos
+            if mgr is not None:
+                mgr.conn_closed(conn.tenant)
+            conn.tenant = None
         with self._lock:
             conn.dead = True
             pending = conn.pending
@@ -401,9 +419,34 @@ class AsyncHTTPServer:
         self._idle[conn.fd] = conn
         self._idle.move_to_end(conn.fd)
         while len(self._idle) > self.keepalive_budget:
-            _fd, lru = self._idle.popitem(last=False)
+            victim = None
+            if _qos is not None:
+                # weighted keep-alive budget: reclaim from the tenant
+                # furthest past its share first, LRU within the tenant
+                victim = self._pick_idle_victim(_qos)
+            if victim is None:
+                _fd, victim = self._idle.popitem(last=False)
+            else:
+                del self._idle[victim.fd]
             self._shed_idle.inc()
-            self._close_conn(lru)
+            self._close_conn(victim)
+
+    def _pick_idle_victim(self, mgr) -> Optional[_Connection]:
+        """The LRU idle connection of the tenant most over its weighted
+        share of the keep-alive budget; None = nobody is over (plain
+        LRU applies). Only runs while the budget is exceeded, so the
+        scan is bounded by the budget itself."""
+        counts: Dict[str, int] = {}
+        for c in self._idle.values():
+            if c.tenant is not None:
+                counts[c.tenant] = counts.get(c.tenant, 0) + 1
+        worst = mgr.most_over_share(counts, self.keepalive_budget)
+        if worst is None:
+            return None
+        for c in self._idle.values():   # insertion order = LRU first
+            if c.tenant == worst:
+                return c
+        return None
 
     def _mark_active(self, conn: _Connection) -> None:
         self._idle.pop(conn.fd, None)
@@ -551,6 +594,8 @@ class AsyncHTTPServer:
             conn.state = _ST_WRITE
             self._start_write(conn)
             return False
+        if _qos is not None and self._frame_shed(conn, shim):
+            return False
         conn.shim = shim
         conn.expect_sent = bool(early)
         shim._expect_sent = conn.expect_sent
@@ -570,6 +615,33 @@ class AsyncHTTPServer:
         else:
             conn.body_remaining = parse_content_length(shim.headers)
             conn.state = _ST_BODY
+        return True
+
+    def _frame_shed(self, conn: _Connection, shim) -> bool:
+        """LOOP-thread QoS connection policing, run per framed request
+        before worker handoff: account the connection to its tenant,
+        and — once the process is near the conn cap — refuse a tenant
+        past its weighted share of -serve.maxConns with the same
+        429/503 + Retry-After reply the admission seam writes. True =
+        shed (reply queued, connection closing)."""
+        mgr = _qos
+        name = mgr.state_of(mgr.resolve(shim.headers, shim.path)).name
+        if name != conn.tenant:
+            if conn.tenant is not None:
+                mgr.conn_closed(conn.tenant)
+            conn.tenant = name
+            mgr.conn_opened(name)
+        if len(self._conns) < self.max_conns * _QOS_CONN_HIGH_WATER:
+            return False
+        if not mgr.conn_over_share(name, self.max_conns):
+            return False
+        mgr.shed_reply(shim, self.role, name, 1.0, "conns")
+        self._shed_qos.inc()
+        conn.inbuf.clear()
+        conn.out.extend(self._as_wire(shim.wfile.take()))
+        conn.close_after = True
+        conn.state = _ST_WRITE
+        self._start_write(conn)
         return True
 
     def _head_error(self, conn: _Connection, code: int) -> None:

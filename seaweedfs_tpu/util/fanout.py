@@ -23,6 +23,17 @@ import queue
 import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+# Weighted-fair scheduling seam: seaweedfs_tpu.qos.configure() installs
+# its manager here (reset() clears it). None — the default — keeps
+# submit() one identity check away from the plain FIFO path, which is
+# what tests/test_perf_gates.py::test_qos_disabled_overhead gates.
+_qos_sched = None
+
+# queue token standing in for one task parked in the pool's weighted-
+# fair queue: the SimpleQueue stays the worker WAKEUP channel (stop()
+# sentinel semantics untouched), the WFQ decides the ORDER
+_WFQ_TOKEN = object()
+
 
 class Future:
     """Result slot for one submitted task: wait() -> (result, exc)."""
@@ -65,6 +76,9 @@ class FanOutPool:
         # thread_count() reads lock-free (introspection may be stale)
         self._threads: List[threading.Thread] = []  # guarded_by(self._lock, writes)
         self._stopping = False  # guarded_by(self._lock)
+        # weighted-fair backlog, built lazily on the first submit made
+        # while QoS is on (None forever otherwise)
+        self._wfq = None  # guarded_by(self._lock, writes)
 
     def thread_count(self) -> int:
         return len(self._threads)
@@ -74,6 +88,11 @@ class FanOutPool:
             item = self._q.get()
             if item is None:   # stop() sentinel
                 return
+            if item is _WFQ_TOKEN:
+                wfq = self._wfq
+                item = wfq.pop() if wfq is not None else None
+                if item is None:
+                    continue
             fut, ctx, fn, args = item
             self._run_task(fut, ctx, fn, args)
 
@@ -101,11 +120,23 @@ class FanOutPool:
         # same lock first), so it always gets a worker; a submit that
         # sees _stopping runs inline instead — no window where a task
         # lands behind the sentinels and hangs its Future forever
+        qos = _qos_sched
         with self._lock:
             stopping = self._stopping
             if not stopping:
-                # lint: block-ok(SimpleQueue.put never blocks; the lock orders enqueue against stop's sentinels)
-                self._q.put((fut, ctx, fn, args))
+                if qos is not None:
+                    # weighted-fair path: the task parks in the WFQ
+                    # (ordered by tenant weight), a token wakes one
+                    # worker; transport and stop semantics unchanged
+                    wfq = self._wfq
+                    if wfq is None:
+                        wfq = self._wfq = qos.make_wfq(self.name)
+                    wfq.put((fut, ctx, fn, args))
+                    # lint: block-ok(SimpleQueue.put never blocks; the lock orders enqueue against stop's sentinels)
+                    self._q.put(_WFQ_TOKEN)
+                else:
+                    # lint: block-ok(SimpleQueue.put never blocks; the lock orders enqueue against stop's sentinels)
+                    self._q.put((fut, ctx, fn, args))
                 if len(self._threads) < self.size:
                     t = threading.Thread(
                         target=self._worker, daemon=True,

@@ -60,10 +60,38 @@ def _export_pool_gauge() -> None:
 _export_pool_gauge()
 
 
+# QoS seam: seaweedfs_tpu.qos.configure() installs the ambient-tenant
+# contextvar here (reset() clears it). When armed, every outbound
+# request forwards the caller's tenant in X-Seaweed-Tenant, so a
+# filer's chunk uploads (or a background engine's repair traffic) are
+# charged to the ORIGINAL tenant at the next hop. None (default) keeps
+# the request path one identity check away from unchanged.
+_qos_tenant = None
+_TENANT_HEADER = "X-Seaweed-Tenant"
+
+
 class ConnectError(OSError):
     """Could not establish (or reuse) a connection — the request never
     reached the peer, so replaying it is always safe. The class the
     retry default classifier treats as retryable."""
+
+
+class ServerBusy(OSError):
+    """Explicit backpressure from the peer (HTTP 429/503 with the QoS
+    plane's Retry-After): the request was REFUSED, not executed, so
+    replaying it is always safe — and the peer demonstrably answered,
+    so this never burns breaker evidence (request() records the
+    response as peer-alive before raising). Raised only when the
+    caller opted in via request(busy_raises=True); `retry_after`
+    carries the server's refill estimate in seconds (0.0 when the
+    header was absent or unparseable), which util/retry honors as the
+    backoff pause, capped by the ambient deadline budget."""
+
+    def __init__(self, msg: str, status: int = 503,
+                 retry_after: float = 0.0):
+        super().__init__(msg)
+        self.status = status
+        self.retry_after = retry_after
 
 
 class ResponseError(OSError):
@@ -186,7 +214,7 @@ class Response:
 
 def request(method: str, url: str, body: Optional[bytes] = None,
             headers: Optional[dict] = None, timeout: float = 60.0,
-            pooled: bool = True) -> Response:
+            pooled: bool = True, busy_raises: bool = False) -> Response:
     """One HTTP request over a pooled persistent connection.
 
     `url` is "http://host:port/path?q" or bare "host:port/path?q".
@@ -199,6 +227,12 @@ def request(method: str, url: str, body: Optional[bytes] = None,
       - an enabled circuit breaker fails fast on an open peer and is
         fed by this call's final outcome (any HTTP response counts as
         peer-alive; only connection-level OSError counts as failure)
+      - an ambient QoS tenant is forwarded in X-Seaweed-Tenant
+      - `busy_raises=True` turns a 429/503 response into ServerBusy
+        carrying the server's Retry-After — AFTER the breaker has
+        recorded the response as peer-alive, so explicit backpressure
+        never opens a breaker (the opt-in default keeps existing
+        callers' status-code handling byte-identical)
       - the http.connect / http.response failpoints inject here
     """
     netloc, path = _split(url)
@@ -215,6 +249,13 @@ def request(method: str, url: str, body: Optional[bytes] = None,
         merged = dict(headers) if headers else {}
         merged[deadline.HEADER] = f"{rem:.4f}"
         headers = merged
+    if _qos_tenant is not None:
+        _t = _qos_tenant.get()
+        if _t is not None and not (headers and
+                                   _TENANT_HEADER in headers):
+            merged = dict(headers) if headers else {}
+            merged[_TENANT_HEADER] = _t
+            headers = merged
     tsp = None
     if _ctrace._enabled:
         from seaweedfs_tpu.stats import trace as _trace
@@ -247,6 +288,11 @@ def request(method: str, url: str, body: Optional[bytes] = None,
             raise
         if breaker.enabled:
             breaker.record(netloc, True)
+        if busy_raises and resp.status in (429, 503):
+            raise ServerBusy(
+                f"{method} {netloc}{path}: {resp.status} busy",
+                status=resp.status,
+                retry_after=retry_after_seconds(resp))
         if failpoint._armed:
             resp.body = failpoint.mangle("http.response", resp.body,
                                          peer=netloc,
@@ -301,14 +347,31 @@ def _request_once_retried(netloc: str, path: str, method: str,
     raise RuntimeError("unreachable")
 
 
+def retry_after_seconds(resp: "Response") -> float:
+    """The Retry-After header as seconds (delta-seconds grammar; the
+    HTTP-date form is not spoken on the cluster-internal plane). 0.0
+    when absent or unparseable."""
+    v = resp.header("retry-after")
+    if not v:
+        return 0.0
+    try:
+        return max(0.0, float(v))
+    except ValueError:
+        return 0.0
+
+
 def classify(exc: BaseException) -> str:
     """Bucket a data-plane client error for retry decisions and
-    metrics: 'deadline' | 'breaker' | 'timeout' | 'connect' |
-    'response' | 'other'."""
+    metrics: 'deadline' | 'breaker' | 'busy' | 'timeout' | 'connect'
+    | 'response' | 'other'."""
     if isinstance(exc, deadline.DeadlineExceeded):
         return "deadline"
     if isinstance(exc, breaker.BreakerOpen):
         return "breaker"
+    if isinstance(exc, ServerBusy):
+        # the peer answered (alive) and refused (not executed): safe
+        # to replay once its Retry-After elapses
+        return "busy"
     if isinstance(exc, (RequestTimeout, TimeoutError)):
         return "timeout"
     if isinstance(exc, ConnectError):

@@ -34,7 +34,10 @@ class NonRetryableError(Exception):
 
 def default_retryable(e: Exception) -> bool:
     from seaweedfs_tpu.util import http_client
-    return http_client.classify(e) in ("connect", "other")
+    # "busy" = the peer answered 429/503 WITHOUT executing (QoS
+    # admission shed): always safe to replay, and the server told us
+    # exactly when — the loop honors e.retry_after as the pause
+    return http_client.classify(e) in ("connect", "busy", "other")
 
 
 def _count(name: str, outcome: str) -> None:
@@ -89,6 +92,14 @@ def retry(name: str, fn: Callable[[], T], *, times: int = 6,
                 _count(name, "exhausted")
                 break
             pause = _rand() * wait if jitter else wait
+            # a server-sent Retry-After (qos shed, ServerBusy) beats
+            # the jittered guess: the server computed the exact bucket
+            # refill time, retrying sooner just sheds again. Still
+            # capped by the deadline budget below — backpressure never
+            # extends a caller's time budget.
+            ra = getattr(e, "retry_after", 0.0)
+            if ra and ra > 0:
+                pause = float(ra)
             if budget_end is not None:
                 remaining = budget_end - time.monotonic()
                 if remaining <= 0:

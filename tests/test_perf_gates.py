@@ -1046,3 +1046,72 @@ def test_serve_async_disabled_overhead(tmp_path):
     finally:
         if mod is not None:
             mod.AsyncHTTPServer.__init__ = orig_init
+
+
+def test_qos_disabled_overhead():
+    """The multi-tenant QoS plane (ISSUE 19) must cost nothing while
+    -qos is off: every consumer seam holds a module-global None, the
+    per-call check is one load+is-check, FanOutPool submits never
+    build a weighted queue, the tenant contextvar is never set, and
+    configuring the manager spawns zero threads (buckets are pure
+    clock math — there is no refill daemon to leak)."""
+    import threading
+
+    from seaweedfs_tpu import qos, rpc
+    from seaweedfs_tpu.qos.admission import QosConfig, QosManager
+    from seaweedfs_tpu.stats import metrics
+    from seaweedfs_tpu.util import async_server, fanout, http_client
+    from seaweedfs_tpu.util.fanout import FanOutPool
+
+    # disabled state: every seam is a plain None module global
+    assert qos._manager is None, "qos must be off by default"
+    assert fanout._qos_sched is None
+    assert async_server._qos is None
+    assert metrics._qos_http is None
+    assert http_client._qos_tenant is None
+    assert rpc._qos_tenant is None
+    from seaweedfs_tpu.qos import tenant
+    assert tenant.current.get() is None, \
+        "no ambient tenant may exist while qos is off"
+
+    # the per-request seam is one None check: 200k cycles bound
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        if metrics._qos_http is not None:   # the instrument-wrapper seam
+            raise AssertionError
+        if fanout._qos_sched is not None:   # the pool submit seam
+            raise AssertionError
+    per_call = (time.perf_counter() - t0) / 200_000
+    assert per_call < 2e-6, f"qos-off seam check {per_call * 1e6:.3f} us"
+
+    # qos-off pool submits take the stock FIFO path, never the WFQ
+    pool = FanOutPool(size=2, name="qos-gate-pool")
+    try:
+        futs = [pool.submit(lambda i=i: i) for i in range(8)]
+        for f in futs:
+            f.wait(5)
+        assert pool._wfq is None, \
+            "qos-off submit built a weighted queue"
+    finally:
+        pool.stop()
+
+    # constructing + configuring the manager spawns no threads
+    before = {t.ident for t in threading.enumerate()}
+    mgr = QosManager(QosConfig(request_rate=100.0, bytes_mbps=10.0,
+                               global_request_rate=1000.0))
+    mgr.admit("gate", nbytes=4096)
+    try:
+        qos.configure(QosConfig())
+        assert qos.enabled()
+    finally:
+        qos.reset()
+    after = {t.ident for t in threading.enumerate()}
+    assert after == before, "qos construction spawned threads"
+    assert not any("qos" in t.name.lower()
+                   for t in threading.enumerate()), \
+        "qos left named threads behind"
+
+    # reset() restores the never-configured state exactly
+    assert qos._manager is None and fanout._qos_sched is None
+    assert async_server._qos is None and metrics._qos_http is None
+    assert http_client._qos_tenant is None and rpc._qos_tenant is None

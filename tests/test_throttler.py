@@ -58,3 +58,30 @@ def test_zero_limit_disabled_is_free():
     for _ in range(1000):
         th.maybe_slowdown(1 << 30)
     assert time.monotonic() - t0 < 0.05
+
+
+def test_disabled_fast_path_contract():
+    # ISSUE 19 satellite: limit 0 is a GUARANTEED no-op — the flag is
+    # computed once at construction, maybe_slowdown pays one attribute
+    # check (no clock read), and tokens() reports infinite credit.
+    th = Throttler(0, burst_s=5.0)
+    assert th.disabled
+    th.maybe_slowdown(1 << 40)
+    assert th.tokens() == float("inf")
+    # negative limits are disabled too, not a divide-by-zero trap
+    assert Throttler(-3).disabled
+
+
+def test_tokens_accrues_and_caps():
+    th = Throttler(limit_mbps=10, burst_s=0.2)
+    assert not th.disabled
+    # empty bucket: first bytes pay full price (allow the few bytes
+    # that accrue between construction and this call at 10 MB/s)
+    assert th.tokens() < 10240
+    time.sleep(0.05)
+    mid = th.tokens()
+    assert mid > 0.0              # credit accrues at the limit rate
+    time.sleep(0.4)               # idle long past burst_s
+    cap = 10 * 1024 * 1024 * 0.2
+    assert th.tokens() <= cap + 1.0, "idle credit not capped at burst_s"
+    assert th.tokens() > cap * 0.5
